@@ -1,0 +1,28 @@
+"""distributed_deep_q_tpu — a TPU-native distributed deep Q-learning framework.
+
+A ground-up rebuild of the capability surface of ``defc0n1/distributed-deep-q``
+(Caffe + Spark + parameter-server DQN; see SURVEY.md) designed TPU-first:
+
+- compute: Flax Q-networks compiled by XLA under ``jax.jit``; optional Pallas
+  kernels for fused TD-loss (``ops/``),
+- parallelism: synchronous data parallelism via ``shard_map`` + ``lax.psum``
+  over a ``jax.sharding.Mesh`` (replacing the reference's Spark/param-server
+  asynchronous gradient push/pull — BASELINE.json ``north_star`` [M]),
+- actors: plain-Python CPU actor processes (``actors/game.py``) feeding a
+  replay service over an RPC boundary (``rpc/``), unchanged in role from the
+  reference's ``game.py`` / ``AtariEnv`` workers [M],
+- replay: host-RAM ring buffers (uniform / prioritized / sequence) with an
+  optional C++ native core (``native/``), streaming minibatches into the
+  learner via a double-buffered host→device pipeline.
+
+Reference provenance: the reference mount was empty in every session so far
+(SURVEY.md §0); the authoritative capability surface is the driver-written
+BASELINE.json ``north_star`` + ``configs`` ([M] claims), which this package
+implements symbol-for-symbol (``Solver``, ``QNet``, ``ReplayMemory``,
+``AtariEnv``, ``--backend``).
+"""
+
+__version__ = "0.1.0"
+
+from distributed_deep_q_tpu.config import Config  # noqa: F401
+from distributed_deep_q_tpu.solver import Solver  # noqa: F401

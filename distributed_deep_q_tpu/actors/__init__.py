@@ -1,0 +1,6 @@
+from distributed_deep_q_tpu.actors.game import (  # noqa: F401
+    GymEnv,
+    FakeAtari,
+    NStepAccumulator,
+    make_env,
+)

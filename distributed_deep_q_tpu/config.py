@@ -1,0 +1,249 @@
+"""Single dataclass-tree config system.
+
+The reference splits configuration between argparse flags (``--backend``,
+worker counts, hyperparameters) and Caffe ``.prototxt`` net/solver files
+(SURVEY.md §5.6 [M][R]). Here everything lives in one typed tree; network
+topology is code (Flax modules selected by ``NetConfig.kind``), not config
+files. The top-level ``--backend={tpu,cpu}`` switch is preserved verbatim —
+the north star measures the rebuild "behind the existing Solver/--backend
+switch" (BASELINE.json [M]).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class NetConfig:
+    """Q-network topology. Replaces the reference's ``models/*.prototxt``."""
+
+    kind: str = "mlp"  # mlp | nature_cnn | r2d2
+    num_actions: int = 2
+    # mlp
+    hidden: tuple[int, ...] = (64, 64)
+    # nature_cnn / r2d2 torso input: (H, W, stack)
+    frame_shape: tuple[int, int] = (84, 84)
+    stack: int = 4
+    dueling: bool = False
+    # r2d2
+    lstm_size: int = 512
+    # compute dtype for the torso ("bfloat16" on TPU keeps the MXU fed;
+    # params stay float32)
+    compute_dtype: str = "float32"
+
+
+@dataclass
+class ReplayConfig:
+    capacity: int = 100_000
+    batch_size: int = 64
+    prioritized: bool = False
+    priority_alpha: float = 0.6
+    priority_beta0: float = 0.4
+    priority_beta_steps: int = 1_000_000
+    priority_eps: float = 1e-6
+    n_step: int = 1
+    # minimum fill before learning starts
+    learn_start: int = 1_000
+    # sequence replay (R2D2)
+    sequence_length: int = 80
+    burn_in: int = 40
+    use_native: bool = True  # use the C++ replay core when available
+
+
+@dataclass
+class TrainConfig:
+    lr: float = 1e-4
+    optimizer: str = "adam"  # adam | rmsprop (reference PS used RMSProp/AdaGrad [P])
+    gamma: float = 0.99
+    target_update_period: int = 500  # "every C pulls: θ⁻ ← θ" (SURVEY §3.1 [M])
+    double_dqn: bool = False
+    huber_delta: float = 1.0
+    grad_clip_norm: float = 10.0
+    total_steps: int = 50_000
+    # env steps per gradient step when running single-process
+    train_every: int = 4
+    eval_every: int = 0  # 0 = no periodic eval
+    eval_episodes: int = 5
+    seed: int = 0
+    # use the fused Pallas TD-loss kernel on TPU
+    use_pallas_loss: bool = False
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0
+
+
+@dataclass
+class EnvConfig:
+    id: str = "CartPole-v1"
+    kind: str = "gym"  # gym | atari | fake_atari
+    frame_skip: int = 4
+    frame_shape: tuple[int, int] = (84, 84)
+    stack: int = 4
+    reward_clip: float = 1.0  # 0 disables; Atari clips to ±1 [P]
+    terminal_on_life_loss: bool = True
+    max_episode_steps: int = 27_000  # 108k frames / skip 4, standard Atari cap
+    noop_max: int = 30
+
+
+@dataclass
+class ActorConfig:
+    num_actors: int = 1
+    # Ape-X ε ladder: actor i uses ε = base ** (1 + i/(N-1) * alpha) [T]
+    eps_base: float = 0.4
+    eps_alpha: float = 7.0
+    # single-actor annealed schedule (Nature-DQN style)
+    eps_start: float = 1.0
+    eps_end: float = 0.05
+    eps_decay_steps: int = 10_000
+    eval_eps: float = 0.05
+    # pull fresh θ from the learner every this many env steps (SURVEY §5.8)
+    param_sync_period: int = 400
+    # transitions per RPC AddTransitions message
+    send_batch: int = 64
+    # replay-feed service address
+    host: str = "127.0.0.1"
+    port: int = 6379
+
+
+@dataclass
+class MeshConfig:
+    """Device-mesh / backend selection — the rebuilt ``--backend`` switch.
+
+    ``backend='tpu'`` uses whatever accelerator platform JAX initialized
+    (axon TPU in this container); ``backend='cpu'`` forces the host platform
+    with ``num_fake_devices`` virtual devices — the test/dummy backend
+    (SURVEY §4: the reference's own fake-backend pattern, rebuilt).
+    """
+
+    backend: str = "tpu"  # tpu | cpu
+    num_fake_devices: int = 8  # only for backend=cpu
+    dp: int = 0  # 0 = all available devices on the dp axis
+    model: int = 1  # model-parallel axis (hooks only; SURVEY §2.2: TP not needed)
+
+
+@dataclass
+class Config:
+    net: NetConfig = field(default_factory=NetConfig)
+    replay: ReplayConfig = field(default_factory=ReplayConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    env: EnvConfig = field(default_factory=EnvConfig)
+    actors: ActorConfig = field(default_factory=ActorConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+    def replace(self, **kv: Any) -> "Config":
+        return dataclasses.replace(self, **kv)
+
+
+# ---------------------------------------------------------------------------
+# Presets mirroring BASELINE.json ``configs`` [M]
+# ---------------------------------------------------------------------------
+
+
+def cartpole_config() -> Config:
+    """Config 1: CartPole-v1, 2-layer MLP Q-net, single worker, uniform replay."""
+    c = Config()
+    c.net = NetConfig(kind="mlp", num_actions=2, hidden=(64, 64))
+    c.replay = ReplayConfig(capacity=50_000, batch_size=64, learn_start=1_000)
+    c.train = TrainConfig(
+        lr=1e-3, gamma=0.99, target_update_period=200, total_steps=30_000,
+        train_every=1, grad_clip_norm=10.0,
+    )
+    c.env = EnvConfig(id="CartPole-v1", kind="gym", stack=1, reward_clip=0.0)
+    c.actors = ActorConfig(num_actors=1, eps_decay_steps=5_000, eps_end=0.02)
+    return c
+
+
+def pong_config() -> Config:
+    """Config 2: Atari Pong, Nature-DQN CNN, 4 actors + 1 learner, uniform."""
+    c = Config()
+    c.net = NetConfig(kind="nature_cnn", num_actions=6, compute_dtype="bfloat16")
+    c.replay = ReplayConfig(capacity=1_000_000, batch_size=512, learn_start=20_000)
+    c.train = TrainConfig(lr=6.25e-5, target_update_period=2_500, total_steps=2_000_000)
+    c.env = EnvConfig(id="PongNoFrameskip-v4", kind="atari")
+    c.actors = ActorConfig(num_actors=4)
+    return c
+
+
+def breakout_config() -> Config:
+    """Config 3: Atari Breakout, Double-DQN + prioritized replay, 16 actors."""
+    c = pong_config()
+    c.net = dataclasses.replace(c.net, num_actions=4)
+    c.replay = dataclasses.replace(
+        c.replay, prioritized=True, n_step=3, batch_size=512)
+    c.train = dataclasses.replace(c.train, double_dqn=True)
+    c.env = dataclasses.replace(c.env, id="BreakoutNoFrameskip-v4")
+    c.actors = dataclasses.replace(c.actors, num_actors=16)
+    return c
+
+
+def apex_config() -> Config:
+    """Config 4: Ape-X style — 256 CPU actors, prioritized n-step, dueling."""
+    c = breakout_config()
+    c.net = dataclasses.replace(c.net, dueling=True)
+    c.actors = dataclasses.replace(c.actors, num_actors=256)
+    return c
+
+
+def r2d2_config() -> Config:
+    """Config 5 (stretch): R2D2 recurrent Q-net, sequence replay."""
+    c = apex_config()
+    c.net = dataclasses.replace(c.net, kind="r2d2", lstm_size=512)
+    c.replay = dataclasses.replace(
+        c.replay, sequence_length=80, burn_in=40, batch_size=64)
+    return c
+
+
+PRESETS = {
+    "cartpole": cartpole_config,
+    "pong": pong_config,
+    "breakout": breakout_config,
+    "apex": apex_config,
+    "r2d2": r2d2_config,
+}
+
+
+# ---------------------------------------------------------------------------
+# argparse bridge
+# ---------------------------------------------------------------------------
+
+
+def add_config_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--preset", default="cartpole", choices=sorted(PRESETS))
+    parser.add_argument(
+        "--backend", default="tpu", choices=["tpu", "cpu"],
+        help="Compute backend behind the Solver (north-star mandated switch).")
+    parser.add_argument("--set", nargs="*", default=[], metavar="PATH=VALUE",
+                        help="Override any config field, e.g. train.lr=3e-4")
+
+
+def _coerce(old: Any, s: str) -> Any:
+    if isinstance(old, bool):
+        return s.lower() in ("1", "true", "yes")
+    if isinstance(old, int):
+        return int(s)
+    if isinstance(old, float):
+        return float(s)
+    if isinstance(old, tuple):
+        return tuple(type(old[0])(v) for v in s.split(",")) if s else ()
+    return s
+
+
+def apply_overrides(cfg: Config, overrides: list[str]) -> Config:
+    for item in overrides:
+        path, _, val = item.partition("=")
+        *parents, leaf = path.split(".")
+        node = cfg
+        for p in parents:
+            node = getattr(node, p)
+        setattr(node, leaf, _coerce(getattr(node, leaf), val))
+    return cfg
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    cfg = PRESETS[args.preset]()
+    cfg.mesh.backend = args.backend
+    apply_overrides(cfg, args.set)
+    return cfg

@@ -1,0 +1,101 @@
+"""CLI entry point (SURVEY.md §1 L6, §2 "Entry/CLI" [M]).
+
+Reference surface kept: a ``main.py`` with ``--backend`` and train / eval /
+play modes plus hyperparameter flags. Presets mirror the BASELINE.json
+config matrix; any field is overridable with ``--set path=value``.
+
+Examples:
+    python -m distributed_deep_q_tpu.main train --preset cartpole --backend cpu
+    python -m distributed_deep_q_tpu.main train --preset pong --backend tpu
+    python -m distributed_deep_q_tpu.main eval --preset cartpole --backend cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from distributed_deep_q_tpu.config import add_config_flags, config_from_args
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="distributed_deep_q_tpu")
+    parser.add_argument("mode", choices=["train", "eval", "play"],
+                        help="train: run the training loop; eval: greedy "
+                             "rollouts; play: single greedy episode with "
+                             "per-step printout")
+    add_config_flags(parser)
+    parser.add_argument("--metrics-jsonl", default="",
+                        help="write structured metrics to this JSONL file")
+    parser.add_argument("--distributed", action="store_true",
+                        help="run the actor/learner RPC topology instead of "
+                             "the single-process loop")
+    args = parser.parse_args(argv)
+    cfg = config_from_args(args)
+
+    # Import past flag parsing so --help never initializes JAX backends.
+    from distributed_deep_q_tpu.metrics import Metrics
+    from distributed_deep_q_tpu.train import evaluate, train_single_process
+
+    if args.mode == "train":
+        if args.distributed:
+            try:
+                from distributed_deep_q_tpu.actors.supervisor import (
+                    train_distributed)
+            except ImportError as e:
+                print(f"error: distributed topology unavailable: {e}",
+                      file=sys.stderr)
+                return 2
+            summary = train_distributed(cfg, metrics=Metrics(
+                args.metrics_jsonl or None))
+        else:
+            summary = train_single_process(cfg, metrics=Metrics(
+                args.metrics_jsonl or None))
+        summary.pop("solver", None)
+        print(json.dumps({"mode": "train", **{
+            k: v for k, v in summary.items()
+            if isinstance(v, (int, float, str))}}))
+        return 0
+
+    if args.mode == "eval":
+        from distributed_deep_q_tpu.solver import Solver
+        from distributed_deep_q_tpu.actors.game import make_env
+        import numpy as np
+        env = make_env(cfg.env, seed=cfg.train.seed)
+        cfg.net.num_actions = env.num_actions
+        solver = Solver(cfg, obs_dim=int(np.prod(env.obs_shape)))
+        ret = evaluate(solver, cfg)
+        print(json.dumps({"mode": "eval", "eval_return": ret,
+                          "episodes": cfg.train.eval_episodes,
+                          "note": "untrained parameters unless restored"}))
+        return 0
+
+    if args.mode == "play":
+        from distributed_deep_q_tpu.solver import Solver
+        from distributed_deep_q_tpu.actors.game import FrameStacker, make_env
+        import numpy as np
+        env = make_env(cfg.env, seed=cfg.train.seed)
+        cfg.net.num_actions = env.num_actions
+        solver = Solver(cfg, obs_dim=int(np.prod(env.obs_shape)))
+        rng = np.random.default_rng(cfg.train.seed)
+        stacker = (FrameStacker(env.obs_shape, cfg.env.stack)
+                   if env.obs_dtype == np.uint8 else None)
+        obs, over, t, ep_ret = env.reset(), False, 0, 0.0
+        if stacker:
+            obs = stacker.reset(obs)
+        while not over:
+            a = solver.act(obs, cfg.actors.eval_eps, rng)
+            frame, r, _, over = env.step(a)
+            obs = stacker.push(frame) if stacker else frame
+            ep_ret += r
+            t += 1
+            print(f"t={t} a={a} r={r:+.1f} R={ep_ret:.1f}")
+        print(json.dumps({"mode": "play", "steps": t, "return": ep_ret}))
+        return 0
+
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

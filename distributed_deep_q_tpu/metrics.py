@@ -1,0 +1,76 @@
+"""Structured metrics (SURVEY.md §5.5).
+
+The reference logs via stdout prints + Spark UI [R]; here metrics are
+structured counters written as JSONL (machine-readable for the bench
+harness) with optional TensorBoard mirroring. The north-star counters —
+grad-steps/sec, env-steps/sec, eval return [M] — are first-class.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from typing import Any, IO
+
+
+class Metrics:
+    def __init__(self, jsonl_path: str | None = None,
+                 tensorboard_dir: str | None = None):
+        self._fh: IO[str] | None = open(jsonl_path, "a") if jsonl_path else None
+        self._tb = None
+        if tensorboard_dir:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self._tb = SummaryWriter(tensorboard_dir)
+            except Exception:
+                self._tb = None
+        self._t0 = time.monotonic()
+        self._counters: dict[str, int] = {}
+        self._marks: dict[str, tuple[float, int]] = {}
+
+    # -- counters with rates (grad-steps/sec, env-steps/sec) ---------------
+    def count(self, name: str, inc: int = 1) -> None:
+        self._counters[name] = self._counters.get(name, 0) + inc
+
+    def rate(self, name: str) -> float:
+        """Rate of a counter since the last time rate() was called on it."""
+        now = time.monotonic()
+        cur = self._counters.get(name, 0)
+        t_prev, c_prev = self._marks.get(name, (self._t0, 0))
+        self._marks[name] = (now, cur)
+        dt = max(now - t_prev, 1e-9)
+        return (cur - c_prev) / dt
+
+    def log(self, step: int, **scalars: Any) -> None:
+        rec = {"step": int(step), "t": round(time.monotonic() - self._t0, 3)}
+        for k, v in scalars.items():
+            rec[k] = float(v) if isinstance(v, (int, float)) else v
+        if self._fh:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        if self._tb:
+            for k, v in scalars.items():
+                if isinstance(v, (int, float)):
+                    self._tb.add_scalar(k, v, step)
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+        if self._tb:
+            self._tb.close()
+
+
+class MovingAverage:
+    def __init__(self, window: int = 100):
+        self._q: deque = deque(maxlen=window)
+
+    def add(self, x: float) -> None:
+        self._q.append(float(x))
+
+    @property
+    def value(self) -> float:
+        return sum(self._q) / len(self._q) if self._q else float("nan")
+
+    def __len__(self) -> int:
+        return len(self._q)

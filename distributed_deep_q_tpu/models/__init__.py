@@ -1,0 +1,8 @@
+from distributed_deep_q_tpu.models.qnet import (  # noqa: F401
+    MlpQNet,
+    NatureCnnQNet,
+    R2d2QNet,
+    QNet,
+    build_qnet,
+    init_params,
+)

@@ -1,0 +1,6 @@
+from distributed_deep_q_tpu.ops.losses import (  # noqa: F401
+    huber,
+    bellman_targets,
+    dqn_loss,
+    sequence_dqn_loss,
+)

@@ -1,0 +1,92 @@
+"""DQN loss construction — the compute core the reference's ``Solver`` owns.
+
+The reference Solver "builds Bellman targets (r + γ·max_a' Q_target(s',a')),
+computes loss, runs fwd/bwd, extracts grads" (SURVEY.md §2 "Solver" [M][R]).
+Here targets/loss are pure jax functions, differentiated by
+``jax.value_and_grad`` inside the jitted train step, so forward+backward+
+optimizer compile into a single XLA program (no per-minibatch Python↔C++
+boundary like the reference's pycaffe hot loop, SURVEY §3.1).
+
+All functions are shape-static and elementwise-fusable; XLA folds them into
+the matmul epilogues on TPU. The optional Pallas fused variant lives in
+``ops/pallas_kernels.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def huber(x: jax.Array, delta: float = 1.0) -> jax.Array:
+    """Huber loss elementwise: quadratic within ±delta, linear outside."""
+    abs_x = jnp.abs(x)
+    quad = jnp.minimum(abs_x, delta)
+    return 0.5 * quad * quad + delta * (abs_x - quad)
+
+
+def bellman_targets(
+    reward: jax.Array,           # [B] float32 (n-step summed on host)
+    discount: jax.Array,         # [B] float32: γ^n · (1 - done)
+    q_next_target: jax.Array,    # [B, A] target-net Q(s')
+    q_next_online: jax.Array | None = None,  # [B, A] online Q(s') for DDQN
+    double: bool = False,
+) -> jax.Array:
+    """r + γⁿ·(1-done)·Q⁻(s', a*) with a* from online net when ``double``.
+
+    Double-DQN (van Hasselt 2016) decouples action selection (online net)
+    from evaluation (target net); vanilla DQN maxes the target net directly.
+    """
+    if double:
+        assert q_next_online is not None
+        a_star = jnp.argmax(q_next_online, axis=-1)
+        q_sel = jnp.take_along_axis(
+            q_next_target, a_star[:, None], axis=-1)[:, 0]
+    else:
+        q_sel = jnp.max(q_next_target, axis=-1)
+    return reward + discount * q_sel
+
+
+def dqn_loss(
+    q: jax.Array,         # [B, A] online Q(s)
+    actions: jax.Array,   # [B] int32
+    targets: jax.Array,   # [B] float32 (stop-gradient applied here)
+    weights: jax.Array,   # [B] importance weights (ones when uniform)
+    delta: float = 1.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Weighted Huber TD loss. Returns (scalar loss, |TD| for PER updates)."""
+    q_sa = jnp.take_along_axis(q, actions[:, None].astype(jnp.int32),
+                               axis=-1)[:, 0]
+    td = q_sa - jax.lax.stop_gradient(targets)
+    loss = jnp.mean(weights * huber(td, delta))
+    return loss, jnp.abs(jax.lax.stop_gradient(td))
+
+
+def sequence_dqn_loss(
+    q: jax.Array,         # [B, T, A] online Q over the training window
+    actions: jax.Array,   # [B, T] int32
+    targets: jax.Array,   # [B, T] float32
+    mask: jax.Array,      # [B, T] 1.0 on valid steps, 0.0 past episode end
+    weights: jax.Array,   # [B] per-sequence importance weights
+    delta: float = 1.0,
+    eta: float = 0.9,
+) -> tuple[jax.Array, jax.Array]:
+    """R2D2 sequence TD loss with validity masking.
+
+    Returns (scalar loss, per-sequence priority) where priority follows the
+    R2D2 mixed max/mean rule: η·max_t|TD| + (1-η)·mean_t|TD| (Kapturowski
+    et al. 2019), computed over valid steps only.
+    """
+    q_sa = jnp.take_along_axis(q, actions[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]
+    td = (q_sa - jax.lax.stop_gradient(targets)) * mask
+    per_t = huber(td, delta) * mask
+    denom = jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+    per_seq = jnp.sum(per_t, axis=1) / denom
+    loss = jnp.mean(weights * per_seq)
+
+    abs_td = jnp.abs(jax.lax.stop_gradient(td))
+    max_td = jnp.max(abs_td, axis=1)
+    mean_td = jnp.sum(abs_td, axis=1) / denom
+    priority = eta * max_td + (1.0 - eta) * mean_td
+    return loss, priority

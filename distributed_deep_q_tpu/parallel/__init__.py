@@ -1,0 +1,2 @@
+from distributed_deep_q_tpu.parallel.mesh import make_mesh, mesh_devices  # noqa: F401
+from distributed_deep_q_tpu.parallel.learner import Learner, TrainState  # noqa: F401

@@ -1,0 +1,162 @@
+"""The synchronous data-parallel learner — core of the TPU rebuild.
+
+Replaces the reference's Spark/parameter-server asynchronous gradient
+push/pull (SURVEY.md §2.2, §3.4 [M][P]) with the north-star-mandated design:
+one jitted ``train_step`` wrapped in ``shard_map`` over a ``dp`` device
+mesh; per-device gradients are allreduced with ``lax.pmean`` (psum/n) over
+ICI; parameters, optimizer state, and the target network stay replicated so
+the periodic target refresh ("every C pulls: θ⁻ ← θ", SURVEY §3.1 [M]) is a
+branchless on-device copy — the moral equivalent of "broadcast θ⁻ from
+chip 0" with zero comms, since replicated updates are bitwise identical on
+every chip.
+
+Everything — Bellman targets, forward, backward, optimizer, target refresh —
+compiles into ONE XLA program per step. The reference crosses the Python↔
+Caffe boundary multiple times per minibatch (SURVEY §3.1 hot loop); here the
+host only feeds batches and reads back scalar metrics.
+
+TrainState buffers are donated (``donate_argnums=0``), so parameters and
+optimizer state are updated in place in HBM with no per-step allocation churn.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_deep_q_tpu.config import TrainConfig
+from distributed_deep_q_tpu.ops.losses import bellman_targets, dqn_loss
+from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
+
+
+class TrainState(flax.struct.PyTreeNode):
+    params: Any
+    target_params: Any
+    opt_state: Any
+    step: jax.Array  # int32 scalar
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    """Optimizer chain. The reference PS applied RMSProp/AdaGrad-style
+    updates (SURVEY §3.4 [P]); we default to Adam with the same switch."""
+    if cfg.optimizer == "adam":
+        opt = optax.adam(cfg.lr, eps=1.5e-4)
+    elif cfg.optimizer == "rmsprop":
+        opt = optax.rmsprop(cfg.lr, decay=0.95, eps=1e-2, centered=True)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    if cfg.grad_clip_norm > 0:
+        return optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), opt)
+    return opt
+
+
+class Learner:
+    """Owns the sharded train step for feed-forward Q-nets.
+
+    ``apply_fn(params, obs) -> q`` is the Flax module apply; the sequence
+    (R2D2) learner lives in ``parallel/sequence_learner.py``.
+    """
+
+    def __init__(
+        self,
+        apply_fn: Callable[[Any, jax.Array], jax.Array],
+        cfg: TrainConfig,
+        mesh: Mesh,
+    ):
+        self.apply_fn = apply_fn
+        self.cfg = cfg
+        self.mesh = mesh
+        self.opt = make_optimizer(cfg)
+        self._replicated = NamedSharding(mesh, P())
+        self._batch_sharding = NamedSharding(mesh, P(AXIS_DP))
+        self._train_step = self._build_train_step()
+
+    # -- state -------------------------------------------------------------
+
+    def init_state(self, params: Any) -> TrainState:
+        """Build a fully-replicated TrainState on the mesh."""
+        state = TrainState(
+            params=params,
+            target_params=jax.tree.map(jnp.copy, params),
+            opt_state=self.opt.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+        return jax.device_put(state, self._replicated)
+
+    # -- train step --------------------------------------------------------
+
+    def _build_train_step(self):
+        cfg = self.cfg
+        apply_fn = self.apply_fn
+        opt = self.opt
+
+        def step_fn(state: TrainState, batch: dict[str, jax.Array]):
+            def loss_fn(params):
+                q = apply_fn(params, batch["obs"])
+                q_next_t = apply_fn(state.target_params, batch["next_obs"])
+                q_next_o = (apply_fn(params, batch["next_obs"])
+                            if cfg.double_dqn else None)
+                # action selection must not backprop into the online net
+                if q_next_o is not None:
+                    q_next_o = lax.stop_gradient(q_next_o)
+                targets = bellman_targets(
+                    batch["reward"], batch["discount"], q_next_t,
+                    q_next_o, cfg.double_dqn)
+                loss, td_abs = dqn_loss(
+                    q, batch["action"], targets, batch["weight"],
+                    cfg.huber_delta)
+                return loss, (td_abs, q)
+
+            (loss, (td_abs, q)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
+
+            # THE collective: gradient allreduce over ICI — replaces the
+            # reference's PS push/pull (north star [M]).
+            grads = lax.pmean(grads, AXIS_DP)
+            loss = lax.pmean(loss, AXIS_DP)
+            q_mean = lax.pmean(jnp.mean(q), AXIS_DP)
+
+            updates, opt_state = opt.update(grads, state.opt_state,
+                                            state.params)
+            params = optax.apply_updates(state.params, updates)
+            step = state.step + 1
+
+            # θ⁻ ← θ every C steps (SURVEY §3.1 [M]); lax.cond keeps the
+            # copy off the hot path on non-refresh steps.
+            target_params = lax.cond(
+                step % cfg.target_update_period == 0,
+                lambda: params,
+                lambda: state.target_params,
+            )
+            new_state = TrainState(params, target_params, opt_state, step)
+            metrics = {
+                "loss": loss,
+                "q_mean": q_mean,
+                "grad_norm": optax.global_norm(grads),
+            }
+            return new_state, metrics, td_abs
+
+        sharded = shard_map(
+            step_fn,
+            mesh=self.mesh,
+            in_specs=(P(), P(AXIS_DP)),
+            out_specs=(P(), P(), P(AXIS_DP)),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=0)
+
+    def train_step(self, state: TrainState, batch: dict[str, Any]):
+        """One synchronous DP gradient step.
+
+        ``batch`` arrays have global leading dim B (divisible by mesh dp
+        size); returns (new_state, metrics dict of scalars, |TD| [B] for
+        PER priority updates).
+        """
+        return self._train_step(state, batch)

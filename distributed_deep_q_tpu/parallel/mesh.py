@@ -1,0 +1,58 @@
+"""Device-mesh construction — the rebuilt ``--backend`` switch (SURVEY §7.1).
+
+The reference selects its compute backend with a ``--backend`` flag on the
+``Solver`` [M]. Here a backend is (platform, device mesh): ``tpu`` uses the
+accelerator platform JAX initialized; ``cpu`` forces the host platform with
+N virtual devices (``jax_num_cpu_devices``) — the dummy/test backend that
+lets the full multi-device psum learner run anywhere (SURVEY §4).
+
+Mesh axes: ``dp`` (data parallel — batch sharded, grads psum'ed over ICI)
+and ``model`` (tensor-parallel hook; size 1 for every reference config —
+SURVEY §2.2 records TP/PP as deliberately out of scope).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from distributed_deep_q_tpu.config import MeshConfig
+
+AXIS_DP = "dp"
+AXIS_MODEL = "model"
+
+
+def _cpu_devices(n: int) -> list[jax.Device]:
+    """Force-create n virtual CPU devices (works pre- or post-backend-init)."""
+    try:
+        # pre-init: steer platform selection (overrides the container's
+        # sitecustomize JAX_PLATFORMS latch)
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+    except Exception:
+        pass
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        raise RuntimeError(
+            f"backend=cpu wants {n} virtual devices but only {len(devs)} exist; "
+            "set mesh.num_fake_devices before any JAX backend initialization")
+    return devs[:n]
+
+
+def mesh_devices(cfg: MeshConfig) -> list[jax.Device]:
+    if cfg.backend == "cpu":
+        n = cfg.num_fake_devices if cfg.dp == 0 else cfg.dp * max(cfg.model, 1)
+        return _cpu_devices(n)
+    if cfg.backend != "tpu":
+        raise ValueError(f"unknown backend {cfg.backend!r} (want tpu|cpu)")
+    return jax.devices()
+
+
+def make_mesh(cfg: MeshConfig) -> Mesh:
+    devs = mesh_devices(cfg)
+    model = max(cfg.model, 1)
+    dp = cfg.dp if cfg.dp > 0 else len(devs) // model
+    devs = devs[: dp * model]
+    arr = np.asarray(devs).reshape(dp, model)
+    return Mesh(arr, (AXIS_DP, AXIS_MODEL))

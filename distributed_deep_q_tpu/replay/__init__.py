@@ -1,0 +1,4 @@
+from distributed_deep_q_tpu.replay.replay_memory import (  # noqa: F401
+    ReplayMemory,
+    FrameStackReplay,
+)

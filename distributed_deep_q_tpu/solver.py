@@ -1,0 +1,102 @@
+"""``Solver`` — the backend-dispatching train-step owner (SURVEY.md §2 [M]).
+
+Reference surface kept verbatim: a ``Solver`` constructed with a
+``--backend`` switch that owns the DQN loss/targets and the per-minibatch
+``train_step``, plus weight IO (``update`` / ``get_weights``) for the
+distribution layer. What changed underneath (north star [M]): the backend is
+now a JAX device mesh + compile strategy — ``tpu`` compiles the step for the
+accelerator, ``cpu`` runs the identical program on N virtual host devices —
+and gradient exchange is an in-step ``lax.pmean`` over ICI instead of a
+parameter-server round trip.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_deep_q_tpu.config import Config
+from distributed_deep_q_tpu.models.qnet import build_qnet, init_params
+from distributed_deep_q_tpu.parallel.learner import Learner, TrainState
+from distributed_deep_q_tpu.parallel.mesh import make_mesh
+
+
+class Solver:
+    """Facade over (module, mesh, learner, state).
+
+    API parity with the reference Solver [M]:
+      - ``train_step(batch) -> metrics``  (fwd+bwd+optimize, one XLA program)
+      - ``update(weights)`` / ``get_weights()``  (numpy weight IO for RPC)
+      - ``q_values(obs)``  (the actor-side forward path)
+    """
+
+    def __init__(self, config: Config, obs_dim: int = 4,
+                 backend: str | None = None):
+        if config.net.kind == "r2d2":
+            raise NotImplementedError(
+                "r2d2 uses the sequence learner "
+                "(parallel/sequence_learner.py + SequenceSolver)")
+        self.config = config
+        if backend is not None:
+            # don't mutate the caller's config tree
+            import dataclasses
+            config = dataclasses.replace(
+                config, mesh=dataclasses.replace(config.mesh, backend=backend))
+            self.config = config
+        self.backend = config.mesh.backend
+        self.mesh = make_mesh(config.mesh)
+        self.module = build_qnet(config.net)
+        self.apply_fn = lambda p, o: self.module.apply({"params": p}, o)
+        self.learner = Learner(self.apply_fn, config.train, self.mesh)
+        params = init_params(self.module, config.net, config.train.seed, obs_dim)
+        self.state: TrainState = self.learner.init_state(params)
+        self._treedef = jax.tree_util.tree_structure(params)
+        self._qv = jax.jit(self.apply_fn)
+
+    # -- training ----------------------------------------------------------
+
+    @property
+    def step(self) -> int:
+        return int(self.state.step)
+
+    def train_step(self, batch: dict[str, np.ndarray]) -> dict[str, Any]:
+        """One gradient step on a host batch; returns scalar metrics plus
+        per-sample ``td_abs`` (PER priorities) and the sampled ``index``."""
+        self.state, metrics, td_abs = self.learner.train_step(
+            self.state, {k: v for k, v in batch.items() if k != "index"})
+        out = {k: float(v) for k, v in metrics.items()}
+        out["td_abs"] = np.asarray(td_abs)
+        if "index" in batch:
+            out["index"] = batch["index"]
+        return out
+
+    # -- inference (actor path) -------------------------------------------
+
+    def q_values(self, obs: np.ndarray) -> np.ndarray:
+        if obs.ndim == 1 or (self.config.net.kind != "mlp" and obs.ndim == 3):
+            obs = obs[None]
+        return np.asarray(self._qv(self.state.params, obs))
+
+    def act(self, obs: np.ndarray, epsilon: float,
+            rng: np.random.Generator) -> int:
+        """ε-greedy action — the reference actor policy (SURVEY §3.3 [M])."""
+        if rng.random() < epsilon:
+            return int(rng.integers(self.config.net.num_actions))
+        return int(np.argmax(self.q_values(obs)[0]))
+
+    # -- weight IO (reference parity: QNet/PS serialization surface) -------
+
+    def get_weights(self) -> list[np.ndarray]:
+        return [np.asarray(x)
+                for x in jax.tree_util.tree_leaves(self.state.params)]
+
+    def update(self, weights: list[np.ndarray]) -> None:
+        """Install new parameters (reference ``Solver.update`` [M])."""
+        params = jax.tree_util.tree_unflatten(self._treedef, list(weights))
+        params = jax.device_put(params, self.learner._replicated)
+        self.state = self.state.replace(params=params)
+
+    set_weights = update

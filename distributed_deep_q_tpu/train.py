@@ -1,0 +1,134 @@
+"""Single-process training loop — config 1 (CartPole smoke) and the
+in-process Atari path (SURVEY.md §7.2 step 1: the minimum end-to-end slice).
+
+One process hosts actor + replay + learner; the distributed topology
+(actors over RPC → replay service → mesh learner) lives in ``rpc/`` and
+``actors/supervisor.py`` and reuses the same Solver and replay components.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_deep_q_tpu.actors.game import (
+    FrameStacker, NStepAccumulator, make_env)
+from distributed_deep_q_tpu.config import Config
+from distributed_deep_q_tpu.metrics import Metrics, MovingAverage
+from distributed_deep_q_tpu.replay.replay_memory import FrameStackReplay, ReplayMemory
+from distributed_deep_q_tpu.solver import Solver
+
+
+def epsilon_at(step: int, cfg) -> float:
+    """Linear ε anneal (Nature-DQN style single-actor schedule)."""
+    frac = min(step / max(cfg.eps_decay_steps, 1), 1.0)
+    return cfg.eps_start + frac * (cfg.eps_end - cfg.eps_start)
+
+
+def evaluate(solver: Solver, cfg: Config, episodes: int | None = None,
+             seed: int = 10_000) -> float:
+    """Greedy-policy rollouts (ε=eval_eps) → mean episode return
+    (SURVEY §3.5 [M])."""
+    env = make_env(cfg.env, seed=seed)
+    rng = np.random.default_rng(seed)
+    episodes = episodes or cfg.train.eval_episodes
+    pixel_env = env.obs_dtype == np.uint8
+    stacker = FrameStacker(env.obs_shape, cfg.env.stack) if pixel_env else None
+    returns = []
+    for _ in range(episodes):
+        obs, ep_ret, over = env.reset(), 0.0, False
+        if stacker:
+            obs = stacker.reset(obs)
+        while not over:
+            a = solver.act(obs, cfg.actors.eval_eps, rng)
+            frame, r, _, over = env.step(a)
+            obs = stacker.push(frame) if stacker else frame
+            ep_ret += r
+        returns.append(ep_ret)
+    return float(np.mean(returns))
+
+
+def train_single_process(cfg: Config, metrics: Metrics | None = None,
+                         log_every: int = 1_000) -> dict:
+    """Run config-1-style training; returns final summary metrics."""
+    metrics = metrics or Metrics()
+    env = make_env(cfg.env, seed=cfg.train.seed)
+    cfg.net.num_actions = env.num_actions
+    obs_dim = int(np.prod(env.obs_shape))
+    solver = Solver(cfg, obs_dim=obs_dim)
+    rng = np.random.default_rng(cfg.train.seed)
+
+    if cfg.replay.prioritized:
+        raise NotImplementedError(
+            "prioritized replay lands with replay/prioritized.py (M4); "
+            "set replay.prioritized=false for now")
+
+    pixel_env = env.obs_dtype == np.uint8
+    if pixel_env:
+        replay = FrameStackReplay(
+            cfg.replay.capacity, env.obs_shape, cfg.env.stack,
+            cfg.replay.n_step, cfg.train.gamma, seed=cfg.train.seed)
+        stacker = FrameStacker(env.obs_shape, cfg.env.stack)
+    else:
+        replay = ReplayMemory(cfg.replay.capacity, env.obs_shape,
+                              np.float32, seed=cfg.train.seed)
+        nstep = NStepAccumulator(cfg.replay.n_step, cfg.train.gamma)
+
+    frame = env.reset()
+    obs = stacker.reset(frame) if pixel_env else frame
+    ep_ret, ep_returns = 0.0, MovingAverage(100)
+    summary: dict = {}
+
+    for t in range(1, cfg.train.total_steps + 1):
+        eps = epsilon_at(t, cfg.actors)
+        a = solver.act(obs, eps, rng)
+        next_frame, r, done, over = env.step(a)
+        ep_ret += r
+
+        if pixel_env:
+            # frame (pre-action), action, reward, done; boundary marks any
+            # episode end incl. truncation so stacks/windows never cross it
+            replay.add(frame, a, r, done, boundary=over)
+            frame = next_frame
+            obs = stacker.push(frame)
+        else:
+            for tr in nstep.push(obs, a, r, next_frame, done):
+                replay.add(*tr)
+            obs = next_frame
+        metrics.count("env_steps")
+
+        if over:
+            if not pixel_env and not done:
+                # time-limit truncation: flush the n-step tail with bootstrap
+                # instead of discarding the end-of-episode transitions
+                for tr in nstep.flush_truncated(next_frame):
+                    replay.add(*tr)
+            ep_returns.add(ep_ret)
+            ep_ret = 0.0
+            frame = env.reset()
+            if pixel_env:
+                obs = stacker.reset(frame)
+            else:
+                obs = frame
+                nstep.reset()
+
+        if (len(replay) >= cfg.replay.learn_start
+                and t % cfg.train.train_every == 0):
+            batch = replay.sample(cfg.replay.batch_size)
+            m = solver.train_step(batch)
+            metrics.count("grad_steps")
+            if solver.step % log_every == 0:
+                summary = {
+                    "loss": m["loss"], "q_mean": m["q_mean"],
+                    "return_avg100": ep_returns.value, "epsilon": eps,
+                    "grad_steps_per_s": metrics.rate("grad_steps"),
+                    "env_steps_per_s": metrics.rate("env_steps"),
+                }
+                metrics.log(solver.step, **summary)
+
+        if (cfg.train.eval_every and t % cfg.train.eval_every == 0):
+            metrics.log(solver.step, eval_return=evaluate(solver, cfg))
+
+    summary["final_return_avg100"] = ep_returns.value
+    summary["eval_return"] = evaluate(solver, cfg)
+    summary["solver"] = solver
+    return summary

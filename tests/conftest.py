@@ -1,0 +1,16 @@
+"""Test harness configuration.
+
+Forces JAX onto the CPU platform with 8 virtual devices so the full
+multi-device learner path (shard_map + psum over a `dp` mesh) is exercised
+without TPU hardware — the TPU-native analogue of the reference's
+`--backend`-switch "dummy backend" testing pattern (SURVEY.md §4 [M]).
+
+NOTE: this container's sitecustomize pre-imports jax and pins
+JAX_PLATFORMS=axon; `jax.config.update` below overrides it *before* any
+backend is initialized (conftest runs before test modules import jax users).
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,148 @@
+"""Replay-memory correctness: wraparound, frame-stack boundaries, n-step
+returns — the reference's own test focus (SURVEY §4: "ReplayMemory
+ring/sample correctness (wraparound, frame-stack at episode boundaries)")."""
+
+import numpy as np
+import pytest
+
+from distributed_deep_q_tpu.replay.replay_memory import (
+    FrameStackReplay, ReplayMemory)
+
+
+def test_ring_wraparound_explicit():
+    rm = ReplayMemory(capacity=5, obs_shape=(2,))
+    for i in range(8):
+        rm.add(np.full(2, i, np.float32), i, float(i), np.full(2, i + 1,
+               np.float32), 0.99)
+    assert len(rm) == 5
+    assert rm.steps_added == 8
+    # slots hold the 5 newest transitions (3..7); slot of i=7 is 7 % 5 = 2
+    assert rm.action[2] == 7
+    batch = rm.sample(64)
+    assert set(np.unique(batch["action"])) <= {3, 4, 5, 6, 7}
+    assert batch["obs"].shape == (64, 2)
+    assert batch["weight"].dtype == np.float32
+
+
+def test_explicit_add_batch_matches_add():
+    rm1 = ReplayMemory(4, (1,))
+    rm2 = ReplayMemory(4, (1,))
+    obs = np.arange(6, dtype=np.float32)[:, None]
+    for i in range(6):
+        rm1.add(obs[i], i, i * 1.0, obs[i], 0.5)
+    rm2.add_batch({"obs": obs, "action": np.arange(6),
+                   "reward": np.arange(6, dtype=np.float32),
+                   "next_obs": obs, "discount": np.full(6, 0.5)})
+    np.testing.assert_array_equal(rm1.obs, rm2.obs)
+    np.testing.assert_array_equal(rm1.action, rm2.action)
+
+
+def _fill_two_episodes(fsr, ep_len=6, h=4):
+    """Two episodes of counter frames: episode 0 frames 1..6, ep 1 frames 7..12."""
+    g = 0
+    for _ in range(2):
+        for t in range(ep_len):
+            g += 1
+            done = t == ep_len - 1
+            fsr.add(np.full((h, h), g, np.uint8), g % 3, float(g), done)
+    return g
+
+
+def test_frame_stack_composition_mid_episode():
+    fsr = FrameStackReplay(100, (4, 4), stack=4, n_step=1, gamma=0.5)
+    _fill_two_episodes(fsr)
+    # slot 4 = frame 5 (0-indexed slot i holds frame i+1); mid-episode
+    b = fsr.gather(np.array([4]))
+    # stack should be frames [2,3,4,5] oldest→newest on last axis
+    got = b["obs"][0, 0, 0, :]
+    np.testing.assert_array_equal(got, [2, 3, 4, 5])
+    # reward = r at slot 4 = 5.0; discount = γ (not terminal)
+    assert b["reward"][0] == 5.0
+    assert b["discount"][0] == pytest.approx(0.5)
+    # next stack = frames [3,4,5,6]
+    np.testing.assert_array_equal(b["next_obs"][0, 0, 0, :], [3, 4, 5, 6])
+
+
+def test_frame_stack_zeroed_before_episode_start():
+    fsr = FrameStackReplay(100, (4, 4), stack=4, n_step=1, gamma=0.5)
+    _fill_two_episodes(fsr)
+    # slot 7 = frame 8 = second frame of episode 2 → stack [0, 0, 7, 8]
+    b = fsr.gather(np.array([7]))
+    np.testing.assert_array_equal(b["obs"][0, 0, 0, :], [0, 0, 7, 8])
+
+
+def test_terminal_transition_discount_zero():
+    fsr = FrameStackReplay(100, (4, 4), stack=4, n_step=1, gamma=0.5)
+    _fill_two_episodes(fsr)
+    # slot 5 = frame 6 = last of episode 1 → done, discount 0
+    b = fsr.gather(np.array([5]))
+    assert b["discount"][0] == 0.0
+    assert b["reward"][0] == 6.0
+
+
+def test_n_step_return_and_truncation():
+    fsr = FrameStackReplay(100, (4, 4), stack=2, n_step=3, gamma=0.5)
+    _fill_two_episodes(fsr)
+    # slot 1 (frame 2, rewards 2,3,4 ahead, no done in [1,3]):
+    b = fsr.gather(np.array([1]))
+    assert b["reward"][0] == pytest.approx(2 + 0.5 * 3 + 0.25 * 4)
+    assert b["discount"][0] == pytest.approx(0.5 ** 3)
+    # next stack ends at frame 2+3=5
+    np.testing.assert_array_equal(b["next_obs"][0, 0, 0, :], [4, 5])
+    # slot 4 (frame 5): done at slot 5 (frame 6) → truncated return r5+γr6
+    b = fsr.gather(np.array([4]))
+    assert b["reward"][0] == pytest.approx(5 + 0.5 * 6)
+    assert b["discount"][0] == 0.0
+
+
+def test_invalid_zone_near_cursor_when_full():
+    fsr = FrameStackReplay(capacity=12, frame_shape=(4, 4), stack=4, n_step=2,
+                           gamma=0.9)
+    _fill_two_episodes(fsr)  # exactly fills capacity 12
+    _fill_two_episodes(fsr)  # wraps entirely; cursor back at 0
+    idx = fsr.sample_indices(256)
+    # window [i-3, i+2] must not straddle the cursor (at 0): back distance
+    # rule forbids back >= cap-n (10, 11) and back < stack-1 (0, 1, 2)
+    back = (idx - fsr._cursor) % fsr.capacity
+    assert ((back >= 3) & (back < 10)).all()
+
+
+def test_truncation_boundary_excluded_from_sampling():
+    """Time-limit truncation (boundary without done) must neither leak into
+    frame stacks nor be sampled inside an n-step window (code-review fix)."""
+    fsr = FrameStackReplay(100, (2, 2), stack=3, n_step=2, gamma=0.9)
+    # episode A: frames 1..5, truncated at frame 5 (done=False, boundary=True)
+    for g in range(1, 6):
+        fsr.add(np.full((2, 2), g, np.uint8), 0, 1.0, False, boundary=(g == 5))
+    # episode B: frames 6..12, terminates normally
+    for g in range(6, 13):
+        fsr.add(np.full((2, 2), g, np.uint8), 0, 1.0, g == 12, boundary=(g == 12))
+    # slots 3 (frame 4) and 4 (frame 5) have windows crossing the truncation
+    assert fsr._invalid(np.array([3, 4])).all()
+    # slot 2 (frame 3): window [2,3] is clean
+    assert not fsr._invalid(np.array([2])).any()
+    # stacks starting in episode B must not contain episode-A frames
+    b = fsr.gather(np.array([6]))  # frame 7, second frame of episode B
+    np.testing.assert_array_equal(b["obs"][0, 0, 0, :], [0, 6, 7])
+    # sampling never returns the excluded slots
+    idx = fsr.sample_indices(512)
+    assert not np.isin(idx, [3, 4]).any()
+
+
+def test_sampled_stacks_never_mix_episodes():
+    rng = np.random.default_rng(0)
+    fsr = FrameStackReplay(64, (2, 2), stack=4, n_step=1, gamma=0.99)
+    # random-length episodes, frame value = episode id
+    ep = 0
+    for _ in range(200):
+        length = int(rng.integers(1, 9))
+        ep += 1
+        for t in range(length):
+            fsr.add(np.full((2, 2), ep % 250, np.uint8), 0, 0.0,
+                    t == length - 1)
+    batch = fsr.sample(512)
+    # within a stack, nonzero frames must all be the same episode id
+    px = batch["obs"][:, 0, 0, :]  # [B, stack]
+    for row in px:
+        vals = set(row[row != 0].tolist())
+        assert len(vals) <= 1, f"mixed episodes in one stack: {row}"

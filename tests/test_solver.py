@@ -1,0 +1,111 @@
+"""Solver / learner tests: multi-device psum numerics vs single device,
+target-net refresh, Double-DQN path, weight IO (SURVEY §4 item 4:
+"jit vs no-jit equivalence", pmap-vs-single-device numerics)."""
+
+import numpy as np
+import pytest
+
+from distributed_deep_q_tpu.config import cartpole_config
+from distributed_deep_q_tpu.solver import Solver
+
+
+def _batch(rng, b=64, obs=4, actions=2):
+    return {
+        "obs": rng.normal(size=(b, obs)).astype(np.float32),
+        "action": rng.integers(0, actions, b).astype(np.int32),
+        "reward": rng.normal(size=b).astype(np.float32),
+        "next_obs": rng.normal(size=(b, obs)).astype(np.float32),
+        "discount": np.full(b, 0.99, np.float32),
+        "weight": np.ones(b, np.float32),
+    }
+
+
+def _solver(dp: int, **train_kw) -> Solver:
+    cfg = cartpole_config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.dp = dp
+    for k, v in train_kw.items():
+        setattr(cfg.train, k, v)
+    return Solver(cfg, obs_dim=4)
+
+
+def test_multi_device_matches_single_device():
+    """The 8-way psum learner must produce the same parameters as a
+    1-device learner on the identical global batch — the rebuilt analogue
+    of testing distributed plumbing against the local backend."""
+    rng = np.random.default_rng(0)
+    batches = [_batch(np.random.default_rng(i)) for i in range(5)]
+    s1, s8 = _solver(1), _solver(8)
+    for b in batches:
+        m1 = s1.train_step(dict(b))
+        m8 = s8.train_step(dict(b))
+        assert m1["loss"] == pytest.approx(m8["loss"], rel=2e-4, abs=1e-6)
+    for w1, w8 in zip(s1.get_weights(), s8.get_weights()):
+        np.testing.assert_allclose(w1, w8, rtol=2e-4, atol=1e-6)
+
+
+def test_target_refresh_period():
+    s = _solver(8, target_update_period=3)
+    rng = np.random.default_rng(1)
+    import jax
+    tgt0 = [np.asarray(x) for x in
+            jax.tree_util.tree_leaves(s.state.target_params)]
+    s.train_step(_batch(rng))
+    s.train_step(_batch(rng))
+    tgt2 = [np.asarray(x) for x in
+            jax.tree_util.tree_leaves(s.state.target_params)]
+    for a, b in zip(tgt0, tgt2):
+        np.testing.assert_array_equal(a, b)  # unchanged before period
+    s.train_step(_batch(rng))  # step 3 → refresh
+    tgt3 = [np.asarray(x) for x in
+            jax.tree_util.tree_leaves(s.state.target_params)]
+    params3 = s.get_weights()
+    for t, p in zip(tgt3, params3):
+        np.testing.assert_array_equal(t, p)
+
+
+def test_double_dqn_changes_targets():
+    rng = np.random.default_rng(2)
+    b = _batch(rng)
+    s_vanilla = _solver(8, double_dqn=False)
+    s_double = _solver(8, double_dqn=True)
+    # same init (same seed) → first-step loss differs only via target rule;
+    # run a couple of steps so target/online nets diverge
+    for _ in range(3):
+        mv = s_vanilla.train_step(dict(b))
+        md = s_double.train_step(dict(b))
+    assert mv["loss"] != pytest.approx(md["loss"], rel=1e-9)
+
+
+def test_td_abs_matches_manual():
+    s = _solver(1, target_update_period=10_000)
+    rng = np.random.default_rng(3)
+    b = _batch(rng, b=8)
+    q = s.q_values(b["obs"])
+    qn = s.q_values(b["next_obs"])  # target == online at init
+    tgt = b["reward"] + b["discount"] * qn.max(axis=1)
+    manual = np.abs(q[np.arange(8), b["action"]] - tgt)
+    m = s.train_step(dict(b))
+    np.testing.assert_allclose(m["td_abs"], manual, rtol=1e-4, atol=1e-5)
+
+
+def test_weight_update_roundtrip():
+    s1, s2 = _solver(1), _solver(8)
+    rng = np.random.default_rng(4)
+    s1.train_step(_batch(rng))
+    w = s1.get_weights()
+    s2.update(w)
+    obs = rng.normal(size=(3, 4)).astype(np.float32)
+    np.testing.assert_allclose(s1.q_values(obs), s2.q_values(obs),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_loss_decreases_on_fixed_regression():
+    """Sanity: repeated steps on one batch reduce TD loss (optimizer wired
+    correctly through the sharded step)."""
+    s = _solver(8, target_update_period=100_000, lr=3e-3)
+    b = _batch(np.random.default_rng(5))
+    first = s.train_step(dict(b))["loss"]
+    for _ in range(30):
+        last = s.train_step(dict(b))["loss"]
+    assert last < first * 0.5

@@ -1,0 +1,43 @@
+"""End-to-end smoke: the minimum slice (SURVEY §7.2 step 1) runs and learns."""
+
+import numpy as np
+import pytest
+
+from distributed_deep_q_tpu.config import cartpole_config, Config, NetConfig, EnvConfig
+from distributed_deep_q_tpu.train import train_single_process, evaluate
+
+
+def test_cartpole_smoke_runs_and_improves():
+    cfg = cartpole_config()
+    cfg.mesh.backend = "cpu"
+    cfg.train.total_steps = 3_000
+    cfg.replay.learn_start = 300
+    out = train_single_process(cfg, log_every=1000)
+    assert np.isfinite(out["final_return_avg100"])
+    assert out["eval_return"] > 15  # random policy ≈ 9.3 on CartPole
+
+
+def test_fake_atari_pixel_path():
+    """FrameStackReplay + CNN learner end to end on FakeAtari frames."""
+    cfg = Config()
+    cfg.net = NetConfig(kind="nature_cnn", num_actions=4,
+                        frame_shape=(84, 84), stack=4)
+    cfg.env = EnvConfig(id="fake", kind="fake_atari", stack=4)
+    cfg.mesh.backend = "cpu"
+    cfg.replay.capacity = 2_000
+    cfg.replay.batch_size = 16
+    cfg.replay.learn_start = 200
+    cfg.train.total_steps = 260
+    cfg.train.train_every = 4
+    out = train_single_process(cfg, log_every=5)
+    assert np.isfinite(out["eval_return"])
+
+
+@pytest.mark.slow
+def test_cartpole_solves():
+    """Config-1 parity bar: CartPole solved (≥ 400/500 eval)."""
+    cfg = cartpole_config()
+    cfg.mesh.backend = "cpu"
+    out = train_single_process(cfg, log_every=5000)
+    solver = out["solver"]
+    assert evaluate(solver, cfg, episodes=10) >= 400
