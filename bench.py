@@ -1,0 +1,90 @@
+"""Headline benchmark — learner grad-steps/sec on the flagship config.
+
+Measures the synchronous-DP learner's steady-state gradient-step rate on the
+Nature-DQN CNN (BASELINE.json config 2-4 net: dueling, Double-DQN, bfloat16
+torso) at the Pong-config batch size (512), fed from host-RAM batches the
+way the real training loop is (host `device_put` each step, not a synthetic
+on-device loop), on whatever devices the backend exposes (the real TPU chip
+under the driver; a CPU mesh elsewhere).
+
+Baseline normalization (`vs_baseline`): BASELINE.json records NO published
+reference numbers (`published: {}`), so the denominator is the documented
+estimate of the single-GPU Caffe learner the north star is measured against:
+~100 grad-steps/s at batch 32 (≈10 ms/iter fwd+bwd+update for the Nature CNN
+on 2015-era Caffe/cuDNN) = 3200 transitions/s. We convert to the same
+transitions/s unit: vs_baseline = (grad_steps_per_sec * 512) / 3200. The
+north-star target is vs_baseline ≥ 50.
+
+Prints ONE JSON line:
+  {"metric": "learner_grad_steps_per_sec", "value": N, "unit": "steps/s",
+   "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BATCH = 512
+WARMUP = 5
+ITERS = 30
+CAFFE_BASELINE_TRANSITIONS_PER_S = 3200.0  # documented estimate, see module doc
+
+
+def main() -> None:
+    import jax
+
+    from distributed_deep_q_tpu.config import Config, NetConfig, TrainConfig
+    from distributed_deep_q_tpu.solver import Solver
+
+    cfg = Config()
+    cfg.net = NetConfig(kind="nature_cnn", num_actions=6, dueling=True,
+                        compute_dtype="bfloat16")
+    cfg.train = TrainConfig(double_dqn=True, target_update_period=2500)
+    platform = jax.devices()[0].platform
+    cfg.mesh.backend = "tpu" if platform not in ("cpu",) else "cpu"
+    if cfg.mesh.backend == "cpu":
+        cfg.mesh.num_fake_devices = max(len(jax.devices("cpu")), 1)
+
+    solver = Solver(cfg)
+
+    rng = np.random.default_rng(0)
+    def make_batch():
+        return {
+            "obs": rng.integers(0, 255, (BATCH, 84, 84, 4), dtype=np.uint8),
+            "action": rng.integers(0, 6, BATCH).astype(np.int32),
+            "reward": rng.standard_normal(BATCH).astype(np.float32),
+            "next_obs": rng.integers(0, 255, (BATCH, 84, 84, 4),
+                                     dtype=np.uint8),
+            "discount": np.full(BATCH, 0.99, np.float32),
+            "weight": np.ones(BATCH, np.float32),
+        }
+
+    # a few distinct host batches so we measure real H2D traffic, not a
+    # cached transfer
+    batches = [make_batch() for _ in range(4)]
+
+    for i in range(WARMUP):
+        solver.train_step(batches[i % len(batches)])
+    jax.block_until_ready(solver.state.params)
+
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        m = solver.train_step(batches[i % len(batches)])
+    jax.block_until_ready(solver.state.params)
+    dt = time.perf_counter() - t0
+
+    steps_per_s = ITERS / dt
+    vs_baseline = steps_per_s * BATCH / CAFFE_BASELINE_TRANSITIONS_PER_S
+    print(json.dumps({
+        "metric": "learner_grad_steps_per_sec",
+        "value": round(steps_per_s, 2),
+        "unit": "steps/s",
+        "vs_baseline": round(vs_baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,7 @@ class NetConfig:
     dueling: bool = False
     # r2d2
     lstm_size: int = 512
+    torso: str = "nature_cnn"  # r2d2 feature torso: nature_cnn | mlp
     # compute dtype for the torso ("bfloat16" on TPU keeps the MXU fed;
     # params stay float32)
     compute_dtype: str = "float32"
@@ -172,7 +173,10 @@ def breakout_config() -> Config:
     c = pong_config()
     c.net = dataclasses.replace(c.net, num_actions=4)
     c.replay = dataclasses.replace(
-        c.replay, prioritized=True, n_step=3, batch_size=512)
+        c.replay, prioritized=True, n_step=3, batch_size=512,
+        # β anneals per sample() (= per grad step): reach β=1 by end of
+        # training (total_steps env steps / train_every)
+        priority_beta_steps=c.train.total_steps // c.train.train_every)
     c.train = dataclasses.replace(c.train, double_dqn=True)
     c.env = dataclasses.replace(c.env, id="BreakoutNoFrameskip-v4")
     c.actors = dataclasses.replace(c.actors, num_actors=16)

@@ -168,7 +168,9 @@ def build_qnet(cfg: NetConfig) -> nn.Module:
     if cfg.kind == "nature_cnn":
         return NatureCnnQNet(cfg.num_actions, cfg.dueling, dtype)
     if cfg.kind == "r2d2":
-        return R2d2QNet(cfg.num_actions, cfg.lstm_size, "nature_cnn",
+        if cfg.torso not in ("nature_cnn", "mlp"):
+            raise ValueError(f"unknown r2d2 torso: {cfg.torso!r}")
+        return R2d2QNet(cfg.num_actions, cfg.lstm_size, cfg.torso,
                         tuple(cfg.hidden), cfg.dueling, dtype)
     raise ValueError(f"unknown net kind: {cfg.kind!r}")
 

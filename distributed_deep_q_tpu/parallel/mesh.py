@@ -27,9 +27,11 @@ def _cpu_devices(n: int) -> list[jax.Device]:
     """Force-create n virtual CPU devices (works pre- or post-backend-init)."""
     try:
         # pre-init: steer platform selection (overrides the container's
-        # sitecustomize JAX_PLATFORMS latch)
+        # sitecustomize JAX_PLATFORMS latch). Only ever *raise* the device
+        # count — a small mesh built first must not cap later larger ones.
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", n)
+        cur = getattr(jax.config, "jax_num_cpu_devices", -1)
+        jax.config.update("jax_num_cpu_devices", max(cur, n))
     except Exception:
         pass
     devs = jax.devices("cpu")

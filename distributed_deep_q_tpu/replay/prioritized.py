@@ -1,0 +1,198 @@
+"""Prioritized experience replay (PER) — config 3/4 capability [M].
+
+The reference has uniform replay only; Double-DQN + PER is mandated by the
+BASELINE.json config matrix ("Breakout, Double-DQN + prioritized replay").
+Design follows Schaul et al. 2016 (proportional variant):
+
+- Host-side **sum tree** over slot priorities (pointer-chasing → host, per
+  SURVEY §7.3 item 2). The tree is a flat numpy array with fully vectorized
+  batched set/sample (no Python per-element recursion); an optional C++ core
+  (``native/``) replaces the descent loop when built.
+- **Priorities** p = (|TD| + ε)^α set from the learner's per-sample ``td_abs``
+  output each step — an async device→host round trip that never blocks the
+  next train step (the learner returns |TD| as part of the step's outputs).
+- **IS weights** w = (N·P(i))^-β / max_j w_j computed on host at sample time
+  (cheap [B] math), annealing β → 1 over ``priority_beta_steps`` samples.
+
+``PrioritizedReplay`` wraps either base buffer (``ReplayMemory`` or
+``FrameStackReplay``) by composition: storage/gather semantics stay in the
+base, prioritization owns only the index distribution. New slots enter at
+max priority (optimistic: every transition is seen at least once).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SumTree:
+    """Flat-array complete binary tree holding priorities in its leaves.
+
+    ``size`` is the leaf count rounded up to a power of two; node ``i`` has
+    children ``2i`` and ``2i+1``; leaves live at ``[size, 2*size)``; the
+    total mass is at the root, index 1. All ops are batched numpy.
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        size = 1
+        while size < capacity:
+            size *= 2
+        self.size = size
+        self.tree = np.zeros(2 * size, np.float64)
+
+    @property
+    def total(self) -> float:
+        return float(self.tree[1])
+
+    def get(self, idx: np.ndarray) -> np.ndarray:
+        return self.tree[np.asarray(idx) + self.size]
+
+    def set(self, idx: np.ndarray, p: np.ndarray) -> None:
+        """Set leaf priorities and repair all affected ancestors, level by
+        level (duplicate indices resolve to the last write, like numpy)."""
+        leaf = np.asarray(idx, np.int64) + self.size
+        self.tree[leaf] = p
+        parents = np.unique(leaf >> 1)
+        while parents.size and parents[0] >= 1:
+            self.tree[parents] = (self.tree[2 * parents]
+                                  + self.tree[2 * parents + 1])
+            parents = np.unique(parents >> 1)
+            if parents[0] == 0:
+                parents = parents[1:]
+
+    def sample_stratified(self, batch_size: int,
+                          rng: np.random.Generator) -> np.ndarray:
+        """Batched proportional sampling: one uniform draw per stratum of the
+        total mass, then a vectorized root→leaf descent (all lanes descend a
+        level per iteration — log₂(size) numpy steps, no Python recursion)."""
+        total = self.tree[1]
+        assert total > 0, "sample from empty SumTree"
+        targets = (np.arange(batch_size) + rng.random(batch_size)) \
+            * (total / batch_size)
+        idx = np.ones(batch_size, np.int64)
+        while idx[0] < self.size:
+            left = 2 * idx
+            left_sum = self.tree[left]
+            go_right = targets > left_sum
+            targets -= left_sum * go_right
+            idx = left + go_right
+        return idx - self.size
+
+
+class PrioritizedReplay:
+    """Proportional PER over any base buffer with add/gather/index surface.
+
+    Exposes the reference ``ReplayMemory`` API (``add``/``add_batch``/
+    ``sample``/``__len__`` [M]) plus ``update_priorities`` for the learner's
+    per-step |TD| feedback.
+    """
+
+    def __init__(
+        self,
+        base,
+        alpha: float = 0.6,
+        beta0: float = 0.4,
+        beta_steps: int = 1_000_000,
+        eps: float = 1e-6,
+        seed: int = 0,
+    ):
+        self.base = base
+        self.alpha = float(alpha)
+        self.beta0 = float(beta0)
+        self.beta_steps = int(beta_steps)
+        self.eps = float(eps)
+        self.tree = SumTree(base.capacity)
+        self.max_priority = 1.0
+        self._samples = 0
+        self._rng = np.random.default_rng(seed)
+
+    # -- reference-parity surface -----------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.base)
+
+    @property
+    def steps_added(self) -> int:
+        return self.base.steps_added
+
+    @property
+    def beta(self) -> float:
+        frac = min(self._samples / max(self.beta_steps, 1), 1.0)
+        return self.beta0 + frac * (1.0 - self.beta0)
+
+    def add(self, *args, **kwargs) -> int:
+        i = self.base.add(*args, **kwargs)
+        self.tree.set(np.asarray([i]),
+                      np.asarray([self.max_priority ** self.alpha]))
+        return i
+
+    def add_batch(self, batch) -> np.ndarray:
+        idx = self.base.add_batch(batch)
+        self.tree.set(idx, np.full(len(idx), self.max_priority ** self.alpha))
+        return idx
+
+    def sample(self, batch_size: int) -> dict[str, np.ndarray]:
+        idx = self.tree.sample_stratified(batch_size, self._rng)
+        # Base-buffer validity (frame-stack window crossing the cursor,
+        # truncation-only boundaries): redraw invalid lanes through the tree
+        # a few times, then fall back to the base's uniform valid sampler.
+        invalid_fn = getattr(self.base, "_invalid", None)
+        if invalid_fn is not None:
+            bad = invalid_fn(idx)
+            for _ in range(8):
+                if not bad.any():
+                    break
+                idx[bad] = self.tree.sample_stratified(
+                    int(bad.sum()), self._rng)
+                bad = invalid_fn(idx)
+            if bad.any():
+                idx[bad] = self.base.sample_indices(int(bad.sum()))
+
+        self._samples += 1
+        batch = self.base.gather(idx)
+        # IS weights: w_i = (N · P(i))^-β, normalized by the batch max so
+        # updates only ever get scaled down (Schaul et al. §3.4).
+        p = self.tree.get(idx)
+        n = len(self.base)
+        probs = np.maximum(p / max(self.tree.total, 1e-12), 1e-12)
+        w = (n * probs) ** (-self.beta)
+        batch["weight"] = (w / w.max()).astype(np.float32)
+        return batch
+
+    # -- learner feedback --------------------------------------------------
+
+    def update_priorities(self, idx: np.ndarray, td_abs: np.ndarray,
+                          sampled_at: int | None = None) -> None:
+        """Write |TD|-derived priorities back to sampled slots.
+
+        ``sampled_at`` is the buffer's ``steps_added`` snapshot taken when
+        the batch was sampled; slots recycled by writes since then are
+        dropped so a stale |TD| never clobbers a fresh transition's
+        optimistic max-priority bootstrap (the cursor position is always
+        ``steps_added % capacity``, so recency is decidable from counts).
+        """
+        idx = np.asarray(idx, np.int64)
+        td = np.abs(np.asarray(td_abs, np.float64)) + self.eps
+        if sampled_at is not None:
+            written = self.base.steps_added - sampled_at
+            if written > 0:
+                cap = self.base.capacity
+                if written >= cap:
+                    return
+                cursor_then = sampled_at % cap
+                fresh = ((idx - cursor_then) % cap) >= written
+                idx, td = idx[fresh], td[fresh]
+                if idx.size == 0:
+                    return
+        self.tree.set(idx, td ** self.alpha)
+        self.max_priority = max(self.max_priority, float(td.max()))
+
+
+def maybe_prioritize(base, cfg, seed: int = 0):
+    """Wrap ``base`` in PER when ``cfg.prioritized`` (ReplayConfig) is set."""
+    if not cfg.prioritized:
+        return base
+    return PrioritizedReplay(
+        base, alpha=cfg.priority_alpha, beta0=cfg.priority_beta0,
+        beta_steps=cfg.priority_beta_steps, eps=cfg.priority_eps, seed=seed)

@@ -63,12 +63,17 @@ class Solver:
         return int(self.state.step)
 
     def train_step(self, batch: dict[str, np.ndarray]) -> dict[str, Any]:
-        """One gradient step on a host batch; returns scalar metrics plus
-        per-sample ``td_abs`` (PER priorities) and the sampled ``index``."""
+        """One gradient step on a host batch.
+
+        Returns metrics as *device* scalars plus per-sample ``td_abs`` (PER
+        priorities) and the sampled ``index``. Nothing here blocks on the
+        step — callers convert with ``float()``/``np.asarray`` only when
+        they log / write priorities back, keeping dispatch pipelined.
+        """
         self.state, metrics, td_abs = self.learner.train_step(
             self.state, {k: v for k, v in batch.items() if k != "index"})
-        out = {k: float(v) for k, v in metrics.items()}
-        out["td_abs"] = np.asarray(td_abs)
+        out: dict[str, Any] = dict(metrics)
+        out["td_abs"] = td_abs
         if "index" in batch:
             out["index"] = batch["index"]
         return out

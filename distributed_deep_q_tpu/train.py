@@ -14,6 +14,8 @@ from distributed_deep_q_tpu.actors.game import (
     FrameStacker, NStepAccumulator, make_env)
 from distributed_deep_q_tpu.config import Config
 from distributed_deep_q_tpu.metrics import Metrics, MovingAverage
+from distributed_deep_q_tpu.replay.prioritized import (
+    PrioritizedReplay, maybe_prioritize)
 from distributed_deep_q_tpu.replay.replay_memory import FrameStackReplay, ReplayMemory
 from distributed_deep_q_tpu.solver import Solver
 
@@ -57,11 +59,6 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
     solver = Solver(cfg, obs_dim=obs_dim)
     rng = np.random.default_rng(cfg.train.seed)
 
-    if cfg.replay.prioritized:
-        raise NotImplementedError(
-            "prioritized replay lands with replay/prioritized.py (M4); "
-            "set replay.prioritized=false for now")
-
     pixel_env = env.obs_dtype == np.uint8
     if pixel_env:
         replay = FrameStackReplay(
@@ -72,11 +69,14 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
         replay = ReplayMemory(cfg.replay.capacity, env.obs_shape,
                               np.float32, seed=cfg.train.seed)
         nstep = NStepAccumulator(cfg.replay.n_step, cfg.train.gamma)
+    replay = maybe_prioritize(replay, cfg.replay, seed=cfg.train.seed)
 
     frame = env.reset()
     obs = stacker.reset(frame) if pixel_env else frame
     ep_ret, ep_returns = 0.0, MovingAverage(100)
     summary: dict = {}
+    pending = None  # (index, td_abs, sampled_at) awaiting PER write-back
+    gsteps = 0
 
     for t in range(1, cfg.train.total_steps + 1):
         eps = epsilon_at(t, cfg.actors)
@@ -114,11 +114,24 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
         if (len(replay) >= cfg.replay.learn_start
                 and t % cfg.train.train_every == 0):
             batch = replay.sample(cfg.replay.batch_size)
+            sampled_at = replay.steps_added
             m = solver.train_step(batch)
+            gsteps += 1
+            if isinstance(replay, PrioritizedReplay):
+                # one-step-delayed priority write-back: materializing |TD|
+                # for the *previous* step is free by now (its device work is
+                # done), so the fresh step is never host-blocked
+                if pending is not None:
+                    replay.update_priorities(pending[0],
+                                             np.asarray(pending[1]),
+                                             sampled_at=pending[2])
+                pending = (m["index"], m["td_abs"], sampled_at)
             metrics.count("grad_steps")
-            if solver.step % log_every == 0:
+            # host-side counter: reading solver.step would sync on the
+            # just-dispatched device step every iteration
+            if gsteps % log_every == 0:
                 summary = {
-                    "loss": m["loss"], "q_mean": m["q_mean"],
+                    "loss": float(m["loss"]), "q_mean": float(m["q_mean"]),
                     "return_avg100": ep_returns.value, "epsilon": eps,
                     "grad_steps_per_s": metrics.rate("grad_steps"),
                     "env_steps_per_s": metrics.rate("env_steps"),

@@ -1,0 +1,134 @@
+"""PER tests: sum-tree invariants, proportional sampling, IS weights,
+priority updates, and validity interaction with FrameStackReplay."""
+
+import numpy as np
+import pytest
+
+from distributed_deep_q_tpu.config import ReplayConfig
+from distributed_deep_q_tpu.replay.prioritized import (
+    PrioritizedReplay, SumTree, maybe_prioritize)
+from distributed_deep_q_tpu.replay.replay_memory import (
+    FrameStackReplay, ReplayMemory)
+
+
+def test_sumtree_total_and_get():
+    t = SumTree(10)
+    idx = np.array([0, 3, 9])
+    t.set(idx, np.array([1.0, 2.0, 3.0]))
+    assert t.total == pytest.approx(6.0)
+    np.testing.assert_allclose(t.get(idx), [1.0, 2.0, 3.0])
+    t.set(np.array([3]), np.array([5.0]))
+    assert t.total == pytest.approx(9.0)
+
+
+def test_sumtree_proportional_sampling():
+    t = SumTree(8)
+    t.set(np.arange(8), np.array([0, 0, 1, 0, 3, 0, 0, 4], np.float64))
+    rng = np.random.default_rng(0)
+    counts = np.zeros(8)
+    for _ in range(200):
+        idx = t.sample_stratified(64, rng)
+        np.add.at(counts, idx, 1)
+    freqs = counts / counts.sum()
+    np.testing.assert_allclose(freqs[[2, 4, 7]], [1 / 8, 3 / 8, 4 / 8],
+                               atol=0.02)
+    assert counts[[0, 1, 3, 5, 6]].sum() == 0
+
+
+def test_sumtree_duplicate_updates_last_wins():
+    t = SumTree(4)
+    t.set(np.array([1, 1]), np.array([2.0, 7.0]))
+    assert t.get(np.array([1]))[0] == pytest.approx(7.0)
+    assert t.total == pytest.approx(7.0)
+
+
+def _filled_per(capacity=64, n=64):
+    base = ReplayMemory(capacity, (4,), np.float32, seed=1)
+    per = PrioritizedReplay(base, alpha=0.6, beta0=0.4, beta_steps=100, seed=2)
+    for i in range(n):
+        per.add(np.full(4, i, np.float32), i % 3, float(i), np.zeros(4), 0.99)
+    return per
+
+
+def test_per_new_items_sampleable_and_weights_one():
+    per = _filled_per()
+    batch = per.sample(32)
+    # all priorities equal (max_priority) → uniform probs → all weights 1
+    np.testing.assert_allclose(batch["weight"], 1.0)
+    assert set(batch) >= {"obs", "action", "reward", "next_obs", "discount",
+                          "weight", "index"}
+
+
+def test_per_update_priorities_shifts_distribution():
+    per = _filled_per()
+    # crank slot 5's priority way up
+    per.update_priorities(np.array([5]), np.array([100.0]))
+    counts = np.zeros(64)
+    for _ in range(100):
+        idx = per.tree.sample_stratified(64, per._rng)
+        np.add.at(counts, idx, 1)
+    # expected share = 100^α / (100^α + 63·1^α) ≈ 0.20 with α=0.6
+    share = counts[5] / counts.sum()
+    assert share == pytest.approx(100 ** 0.6 / (100 ** 0.6 + 63), abs=0.03)
+
+
+def test_per_is_weights_down_weight_high_priority():
+    per = _filled_per()
+    per.update_priorities(np.arange(64), np.linspace(0.1, 10.0, 64))
+    batch = per.sample(256)
+    w, idx = batch["weight"], batch["index"]
+    p = per.tree.get(idx)
+    # weight must be monotone decreasing in priority; max-normalized to ≤ 1
+    order = np.argsort(p)
+    assert np.all(np.diff(w[order]) <= 1e-9)
+    assert w.max() == pytest.approx(1.0)
+
+
+def test_per_beta_anneals_to_one():
+    per = _filled_per()
+    assert per.beta == pytest.approx(0.4)
+    for _ in range(100):
+        per.sample(8)
+    assert per.beta == pytest.approx(1.0)
+
+
+def test_per_over_framestack_respects_validity():
+    base = FrameStackReplay(128, (4, 4), stack=4, n_step=3, seed=0)
+    per = PrioritizedReplay(base, seed=0)
+    # two episodes of 20 steps, second truncated (boundary without done)
+    for ep in range(2):
+        for t in range(20):
+            last = t == 19
+            per.add(np.full((4, 4), ep * 20 + t, np.uint8), 0, 1.0,
+                    done=last and ep == 0, boundary=last)
+    batch = per.sample(64)
+    assert not base._invalid(batch["index"].astype(np.int64)).any()
+
+
+def test_per_stale_priority_write_dropped():
+    base = ReplayMemory(8, (2,), np.float32)
+    per = PrioritizedReplay(base, alpha=1.0, seed=0)
+    for i in range(8):
+        per.add(np.zeros(2), 0, 0.0, np.zeros(2), 0.99)
+    sampled_at = per.steps_added
+    for _ in range(3):  # recycles slots 0..2
+        per.add(np.zeros(2), 0, 0.0, np.zeros(2), 0.99)
+    per.update_priorities(np.arange(4), np.full(4, 9.0),
+                          sampled_at=sampled_at)
+    p = per.tree.get(np.arange(4))
+    # recycled slots keep their fresh max-priority bootstrap (1.0)...
+    np.testing.assert_allclose(p[:3], 1.0)
+    # ...while the still-live slot takes the new |TD| priority
+    assert p[3] == pytest.approx(9.0 + per.eps)
+    # a full-buffer turnover drops the whole write-back
+    per.update_priorities(np.arange(4), np.full(4, 5.0),
+                          sampled_at=per.steps_added - 8)
+    np.testing.assert_allclose(per.tree.get(np.arange(4))[:3], 1.0)
+
+
+def test_maybe_prioritize_respects_flag():
+    base = ReplayMemory(8, (2,))
+    assert maybe_prioritize(base, ReplayConfig(prioritized=False)) is base
+    assert isinstance(
+        maybe_prioritize(base, ReplayConfig(prioritized=True)),
+        PrioritizedReplay)
