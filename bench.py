@@ -2,16 +2,18 @@
 
 Measures the synchronous-DP learner's steady-state gradient-step rate on the
 Nature-DQN CNN (BASELINE.json config 2-4 net: dueling, Double-DQN, bfloat16
-torso) at the Pong-config batch size (512), fed from host-RAM batches the
-way the real training loop is (host `device_put` each step, not a synthetic
-on-device loop), on whatever devices the backend exposes (the real TPU chip
-under the driver; a CPU mesh elsewhere).
+torso, batch 512, PER-style weighted loss) using the production data path:
+the **device-resident replay ring** (frames in HBM; the host samples indices
+and composes n-step metadata, the jitted step gathers/stacks pixels on
+device — see replay/device_ring.py). Per-step host→device traffic is ~50 KB
+of indices/scalars; pixels cross once, at fill time, like they do at actor
+rate in training.
 
 Baseline normalization (`vs_baseline`): BASELINE.json records NO published
 reference numbers (`published: {}`), so the denominator is the documented
 estimate of the single-GPU Caffe learner the north star is measured against:
 ~100 grad-steps/s at batch 32 (≈10 ms/iter fwd+bwd+update for the Nature CNN
-on 2015-era Caffe/cuDNN) = 3200 transitions/s. We convert to the same
+on 2015-era Caffe/cuDNN) = 3200 transitions/s. We compare in the same
 transitions/s unit: vs_baseline = (grad_steps_per_sec * 512) / 3200. The
 north-star target is vs_baseline ≥ 50.
 
@@ -28,51 +30,56 @@ import time
 import numpy as np
 
 BATCH = 512
-WARMUP = 5
-ITERS = 30
+CAPACITY = 65_536
+PREFILL = 40_000
+WARMUP = 10
+ITERS = 100
 CAFFE_BASELINE_TRANSITIONS_PER_S = 3200.0  # documented estimate, see module doc
 
 
 def main() -> None:
     import jax
 
-    from distributed_deep_q_tpu.config import Config, NetConfig, TrainConfig
+    from distributed_deep_q_tpu.config import (
+        Config, NetConfig, ReplayConfig, TrainConfig)
+    from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
     from distributed_deep_q_tpu.solver import Solver
 
     cfg = Config()
     cfg.net = NetConfig(kind="nature_cnn", num_actions=6, dueling=True,
                         compute_dtype="bfloat16")
     cfg.train = TrainConfig(double_dqn=True, target_update_period=2500)
+    cfg.replay = ReplayConfig(capacity=CAPACITY, batch_size=BATCH, n_step=3,
+                              write_chunk=1024)
     platform = jax.devices()[0].platform
-    cfg.mesh.backend = "tpu" if platform not in ("cpu",) else "cpu"
-    if cfg.mesh.backend == "cpu":
-        cfg.mesh.num_fake_devices = max(len(jax.devices("cpu")), 1)
+    cfg.mesh.backend = "cpu" if platform == "cpu" else "tpu"
 
     solver = Solver(cfg)
+    replay = DeviceFrameReplay(cfg.replay, solver.mesh, (84, 84), stack=4,
+                               gamma=cfg.train.gamma, seed=0,
+                               write_chunk=cfg.replay.write_chunk)
 
+    # Prefill: synthetic episodes stream in like actor traffic (frames cross
+    # the link once, here; during training this happens at actor rate).
     rng = np.random.default_rng(0)
-    def make_batch():
-        return {
-            "obs": rng.integers(0, 255, (BATCH, 84, 84, 4), dtype=np.uint8),
-            "action": rng.integers(0, 6, BATCH).astype(np.int32),
-            "reward": rng.standard_normal(BATCH).astype(np.float32),
-            "next_obs": rng.integers(0, 255, (BATCH, 84, 84, 4),
-                                     dtype=np.uint8),
-            "discount": np.full(BATCH, 0.99, np.float32),
-            "weight": np.ones(BATCH, np.float32),
-        }
+    frames = rng.integers(0, 255, (2048, 84, 84), dtype=np.uint8)
+    for i in range(PREFILL):
+        replay.add(frames[i % len(frames)], int(rng.integers(0, 6)),
+                   float(rng.standard_normal()), done=(i % 1000 == 999))
+    replay.flush()
 
-    # a few distinct host batches so we measure real H2D traffic, not a
-    # cached transfer
-    batches = [make_batch() for _ in range(4)]
+    def one_step():
+        batch = replay.sample(BATCH)
+        batch.pop("_sampled_at", None)
+        return solver.train_step_from_ring(replay.ring, batch)
 
-    for i in range(WARMUP):
-        solver.train_step(batches[i % len(batches)])
+    for _ in range(WARMUP):
+        m = one_step()
     jax.block_until_ready(solver.state.params)
 
     t0 = time.perf_counter()
-    for i in range(ITERS):
-        m = solver.train_step(batches[i % len(batches)])
+    for _ in range(ITERS):
+        m = one_step()
     jax.block_until_ready(solver.state.params)
     dt = time.perf_counter() - t0
 

@@ -49,6 +49,12 @@ class ReplayConfig:
     n_step: int = 1
     # minimum fill before learning starts
     learn_start: int = 1_000
+    # pixel envs: keep the frame ring in device HBM and gather stacks inside
+    # the jitted step (replay/device_ring.py) instead of shipping pixel
+    # minibatches host→device every step
+    device_resident: bool = True
+    # frames staged per shard per HBM write (device-resident mode)
+    write_chunk: int = 64
     # sequence replay (R2D2)
     sequence_length: int = 80
     burn_in: int = 40

@@ -77,6 +77,7 @@ class Learner:
         self._replicated = NamedSharding(mesh, P())
         self._batch_sharding = NamedSharding(mesh, P(AXIS_DP))
         self._train_step = self._build_train_step()
+        self._ring_step = None  # built lazily on first device-replay step
 
     # -- state -------------------------------------------------------------
 
@@ -92,56 +93,60 @@ class Learner:
 
     # -- train step --------------------------------------------------------
 
+    def _step_core(self, state: TrainState, batch: dict[str, jax.Array]):
+        """Loss + allreduce + optimizer + target refresh — shared by the
+        host-batch and device-ring paths. ``batch`` holds per-device local
+        arrays with ``obs``/``next_obs`` already composed."""
+        cfg, apply_fn, opt = self.cfg, self.apply_fn, self.opt
+
+        def loss_fn(params):
+            q = apply_fn(params, batch["obs"])
+            q_next_t = apply_fn(state.target_params, batch["next_obs"])
+            q_next_o = (apply_fn(params, batch["next_obs"])
+                        if cfg.double_dqn else None)
+            # action selection must not backprop into the online net
+            if q_next_o is not None:
+                q_next_o = lax.stop_gradient(q_next_o)
+            targets = bellman_targets(
+                batch["reward"], batch["discount"], q_next_t,
+                q_next_o, cfg.double_dqn)
+            loss, td_abs = dqn_loss(
+                q, batch["action"], targets, batch["weight"],
+                cfg.huber_delta)
+            return loss, (td_abs, q)
+
+        (loss, (td_abs, q)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+
+        # THE collective: gradient allreduce over ICI — replaces the
+        # reference's PS push/pull (north star [M]).
+        grads = lax.pmean(grads, AXIS_DP)
+        loss = lax.pmean(loss, AXIS_DP)
+        q_mean = lax.pmean(jnp.mean(q), AXIS_DP)
+
+        updates, opt_state = opt.update(grads, state.opt_state,
+                                        state.params)
+        params = optax.apply_updates(state.params, updates)
+        step = state.step + 1
+
+        # θ⁻ ← θ every C steps (SURVEY §3.1 [M]); lax.cond keeps the
+        # copy off the hot path on non-refresh steps.
+        target_params = lax.cond(
+            step % cfg.target_update_period == 0,
+            lambda: params,
+            lambda: state.target_params,
+        )
+        new_state = TrainState(params, target_params, opt_state, step)
+        metrics = {
+            "loss": loss,
+            "q_mean": q_mean,
+            "grad_norm": optax.global_norm(grads),
+        }
+        return new_state, metrics, td_abs
+
     def _build_train_step(self):
-        cfg = self.cfg
-        apply_fn = self.apply_fn
-        opt = self.opt
-
         def step_fn(state: TrainState, batch: dict[str, jax.Array]):
-            def loss_fn(params):
-                q = apply_fn(params, batch["obs"])
-                q_next_t = apply_fn(state.target_params, batch["next_obs"])
-                q_next_o = (apply_fn(params, batch["next_obs"])
-                            if cfg.double_dqn else None)
-                # action selection must not backprop into the online net
-                if q_next_o is not None:
-                    q_next_o = lax.stop_gradient(q_next_o)
-                targets = bellman_targets(
-                    batch["reward"], batch["discount"], q_next_t,
-                    q_next_o, cfg.double_dqn)
-                loss, td_abs = dqn_loss(
-                    q, batch["action"], targets, batch["weight"],
-                    cfg.huber_delta)
-                return loss, (td_abs, q)
-
-            (loss, (td_abs, q)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(state.params)
-
-            # THE collective: gradient allreduce over ICI — replaces the
-            # reference's PS push/pull (north star [M]).
-            grads = lax.pmean(grads, AXIS_DP)
-            loss = lax.pmean(loss, AXIS_DP)
-            q_mean = lax.pmean(jnp.mean(q), AXIS_DP)
-
-            updates, opt_state = opt.update(grads, state.opt_state,
-                                            state.params)
-            params = optax.apply_updates(state.params, updates)
-            step = state.step + 1
-
-            # θ⁻ ← θ every C steps (SURVEY §3.1 [M]); lax.cond keeps the
-            # copy off the hot path on non-refresh steps.
-            target_params = lax.cond(
-                step % cfg.target_update_period == 0,
-                lambda: params,
-                lambda: state.target_params,
-            )
-            new_state = TrainState(params, target_params, opt_state, step)
-            metrics = {
-                "loss": loss,
-                "q_mean": q_mean,
-                "grad_norm": optax.global_norm(grads),
-            }
-            return new_state, metrics, td_abs
+            return self._step_core(state, batch)
 
         sharded = shard_map(
             step_fn,
@@ -151,6 +156,42 @@ class Learner:
             check_vma=False,
         )
         return jax.jit(sharded, donate_argnums=0)
+
+    def _build_ring_step(self):
+        """Train step fed by the device-resident frame ring: pixels are
+        gathered/stacked per device from the local ring shard (indices are
+        shard-local), so only [B, stack] int32 + [B] scalars cross the
+        host boundary (SURVEY §7.3 item 1)."""
+        from distributed_deep_q_tpu.replay.device_ring import compose_stacks
+
+        def step_fn(state: TrainState, ring: jax.Array,
+                    batch: dict[str, jax.Array]):
+            composed = {
+                "obs": compose_stacks(ring, batch["oidx"], batch["valid"]),
+                "next_obs": compose_stacks(ring, batch["noidx"],
+                                           batch["nvalid"]),
+                "action": batch["action"],
+                "reward": batch["reward"],
+                "discount": batch["discount"],
+                "weight": batch["weight"],
+            }
+            return self._step_core(state, composed)
+
+        sharded = shard_map(
+            step_fn,
+            mesh=self.mesh,
+            in_specs=(P(), P(AXIS_DP), P(AXIS_DP)),
+            out_specs=(P(), P(), P(AXIS_DP)),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=0)
+
+    def train_step_from_ring(self, state: TrainState, ring: jax.Array,
+                             batch: dict[str, Any]):
+        """One DP step sampling pixels from the HBM ring (device replay)."""
+        if self._ring_step is None:
+            self._ring_step = self._build_ring_step()
+        return self._ring_step(state, ring, batch)
 
     def train_step(self, state: TrainState, batch: dict[str, Any]):
         """One synchronous DP gradient step.

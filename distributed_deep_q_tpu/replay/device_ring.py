@@ -1,0 +1,239 @@
+"""Device-resident replay — frames live in HBM, metadata on host.
+
+The TPU-first redesign of the replay data path (SURVEY.md §7.3 item 1: "this
+is where the 50× target is won or lost"). The reference streams full pixel
+minibatches host→device every step (Caffe blob loads, SURVEY §3.1); a
+pmap-fed rebuild doing the same ships ~29 MB/step at batch 512 — measured
+at ~160 ms over this container's TPU link vs a 0.2 ms train step. Instead:
+
+- **Frames enter HBM once, at actor rate.** A uint8 ring ``[capacity, H, W]``
+  lives on the learner mesh, sharded over the ``dp`` axis (each device owns
+  a contiguous shard — Ape-X-style per-learner replay shards). Actor streams
+  append in fixed-size chunks through a donated ``shard_map`` scatter.
+- **The train step gathers on device.** The host samples *indices* (uniform
+  or PER sum-tree — pointer-chasing stays on host, SURVEY §7.3 item 2),
+  composes n-step returns/validity masks from metadata, and ships only
+  ``[B, stack]`` int32 indices + a few ``[B]`` scalars (~50 KB). Frame-stack
+  composition (gather + zero-masking + transpose) happens inside the jitted
+  step, reading HBM at memory bandwidth.
+
+Sharding invariants:
+- Each episode is routed whole to one shard (``add`` advances the shard
+  pointer on episode boundaries; RPC streams pin ``stream → shard``), so
+  temporal adjacency — which frame-stacking relies on — holds per shard.
+- Sampling draws ``batch/D`` from every shard and concatenates in mesh
+  order, matching ``PartitionSpec('dp')`` row-block layout, so each device
+  gathers only from its local shard — no cross-device collective in the
+  data path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_deep_q_tpu.config import ReplayConfig
+from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
+from distributed_deep_q_tpu.replay.prioritized import PrioritizedReplay
+from distributed_deep_q_tpu.replay.replay_memory import FrameStackReplay
+
+
+def compose_stacks(ring: jax.Array, oidx: jax.Array,
+                   valid: jax.Array) -> jax.Array:
+    """[capL, H, W] ring + [B, stack] indices/mask → [B, H, W, stack] uint8.
+
+    Pure jax; runs per-device inside the learner's shard_map (indices are
+    shard-local). Invalid frames (preceding episode start) zero out, matching
+    ``FrameStackReplay.gather`` / ``FrameStacker.reset`` semantics.
+    """
+    frames = ring[oidx]                                   # [B, S, H, W]
+    frames = frames * valid[..., None, None].astype(jnp.uint8)
+    return jnp.moveaxis(frames, 1, -1)                    # [B, H, W, S]
+
+
+class DeviceFrameReplay:
+    """HBM frame ring + host metadata/priorities, one logical buffer.
+
+    Reference-parity surface (``add`` / ``sample`` / ``__len__`` [M]) plus
+    ``update_priorities``; ``sample`` returns an *index batch* whose pixels
+    are composed on device by the learner's ring train step.
+    """
+
+    def __init__(
+        self,
+        cfg: ReplayConfig,
+        mesh: Mesh,
+        frame_shape: tuple[int, int] = (84, 84),
+        stack: int = 4,
+        gamma: float = 0.99,
+        seed: int = 0,
+        write_chunk: int = 64,
+    ):
+        self.mesh = mesh
+        self.num_shards = mesh.shape[AXIS_DP]
+        d = self.num_shards
+        self.cap_local = int(cfg.capacity) // d
+        assert self.cap_local > 0 and cfg.batch_size % d == 0, \
+            f"capacity {cfg.capacity} / batch {cfg.batch_size} must split over {d} shards"
+        self.capacity = self.cap_local * d
+        self.stack = int(stack)
+        self.frame_shape = tuple(frame_shape)
+        self.write_chunk = int(write_chunk)
+        self.prioritized = bool(cfg.prioritized)
+
+        def meta_ring(i: int) -> FrameStackReplay:
+            return FrameStackReplay(
+                self.cap_local, frame_shape, stack, cfg.n_step, gamma,
+                seed=seed + i, store_frames=False)
+
+        if self.prioritized:
+            self.shards = [
+                PrioritizedReplay(
+                    meta_ring(i), alpha=cfg.priority_alpha,
+                    beta0=cfg.priority_beta0,
+                    beta_steps=cfg.priority_beta_steps,
+                    eps=cfg.priority_eps, seed=seed + 1000 + i)
+                for i in range(d)]
+        else:
+            self.shards = [meta_ring(i) for i in range(d)]
+
+        # HBM ring, allocated directly with its dp sharding (no host copy).
+        ring_sharding = NamedSharding(mesh, P(AXIS_DP))
+        shape = (self.capacity,) + self.frame_shape
+        self.ring = jax.jit(
+            lambda: jnp.zeros(shape, jnp.uint8),
+            out_shardings=ring_sharding)()
+
+        # Donated scatter-writer: each device writes its chunk into its own
+        # ring shard; padding lanes carry idx == cap_local and are dropped.
+        def write(ring_local, idx, frames):
+            return ring_local.at[idx].set(frames, mode="drop")
+
+        self._write = jax.jit(
+            shard_map(write, mesh=mesh,
+                      in_specs=(P(AXIS_DP), P(AXIS_DP), P(AXIS_DP)),
+                      out_specs=P(AXIS_DP)),
+            donate_argnums=0)
+
+        # host-side staging: per-shard pending (local_idx, frame)
+        self._pending: list[list[tuple[int, np.ndarray]]] = [[] for _ in range(d)]
+        self._shard = 0  # episode-routing pointer for single-stream add()
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _meta(self, s: int) -> FrameStackReplay:
+        sh = self.shards[s]
+        return sh.base if isinstance(sh, PrioritizedReplay) else sh
+
+    def __len__(self) -> int:
+        return sum(len(self._meta(s)) for s in range(self.num_shards))
+
+    def ready(self, learn_start: int) -> bool:
+        """True when sampling can proceed: aggregate fill reached AND every
+        shard can form transitions (sample draws batch/D from *each* shard,
+        and episodes route whole to shards, so early on some shards may
+        still be empty — SURVEY §7.3 item 6)."""
+        if len(self) < learn_start:
+            return False
+        return all(
+            len(m) > m.stack + m.n_step and m.valid_fraction() > 0
+            for m in (self._meta(s) for s in range(self.num_shards)))
+
+    @property
+    def steps_added(self) -> int:
+        return sum(self._meta(s).steps_added for s in range(self.num_shards))
+
+    # -- write path ---------------------------------------------------------
+
+    def add(self, frame, action, reward, done, boundary=None) -> int:
+        """Single-stream add; episodes route whole to one shard and the
+        shard pointer advances at each episode boundary."""
+        s = self._shard
+        i = self.shards[s].add(None, action, reward, done, boundary=boundary)
+        self._pending[s].append((i, np.asarray(frame, np.uint8)))
+        episode_over = done if boundary is None else boundary
+        if episode_over:
+            self._shard = (s + 1) % self.num_shards
+        if len(self._pending[s]) >= self.write_chunk:
+            self.flush()
+        return s * self.cap_local + i
+
+    def add_batch(self, batch, stream: int = 0) -> np.ndarray:
+        """RPC-fed contiguous chunk from one actor stream (→ one shard)."""
+        s = stream % self.num_shards
+        idx = self.shards[s].add_batch(
+            {k: v for k, v in batch.items() if k != "frame"} | {
+                "action": batch["action"], "reward": batch["reward"],
+                "done": batch["done"],
+                "boundary": batch.get("boundary", batch["done"])})
+        for i, f in zip(idx, batch["frame"]):
+            self._pending[s].append((int(i), np.asarray(f, np.uint8)))
+        if max(len(p) for p in self._pending) >= self.write_chunk:
+            self.flush()
+        return idx + s * self.cap_local
+
+    def flush(self) -> None:
+        """Push all staged frames to HBM in fixed-shape chunks.
+
+        Every flush writes ``write_chunk`` lanes per shard (one compiled
+        program); shards with fewer pending frames pad with out-of-bounds
+        indices that the scatter drops.
+        """
+        while any(self._pending):
+            k, d = self.write_chunk, self.num_shards
+            idx = np.full((d, k), self.cap_local, np.int32)  # OOB = dropped
+            frames = np.zeros((d, k) + self.frame_shape, np.uint8)
+            for s in range(d):
+                take, self._pending[s] = (self._pending[s][:k],
+                                          self._pending[s][k:])
+                for j, (i, f) in enumerate(take):
+                    idx[s, j], frames[s, j] = i, f
+            self.ring = self._write(
+                self.ring, idx.reshape(d * k),
+                frames.reshape((d * k,) + self.frame_shape))
+
+    # -- sample path --------------------------------------------------------
+
+    def sample(self, batch_size: int) -> dict[str, np.ndarray]:
+        """Index batch (no pixels): per-shard draws concatenated in mesh
+        order so ``P('dp')`` row-blocks land on the owning devices."""
+        self.flush()
+        d = self.num_shards
+        per = batch_size // d
+        parts, weights, sampled_at = [], [], []
+        for s in range(d):
+            sh = self.shards[s]
+            if self.prioritized:
+                idx, w = sh.sample_indices_weighted(per)
+            else:
+                idx, w = sh.sample_indices(per), np.ones(per)
+            m = self._meta(s).gather_meta(idx)
+            m["index"] = (idx + s * self.cap_local).astype(np.int32)
+            parts.append(m)
+            weights.append(w)
+            sampled_at.append(self._meta(s).steps_added)
+        batch = {k: np.concatenate([p[k] for p in parts])
+                 for k in parts[0]}
+        w = np.concatenate(weights)
+        batch["weight"] = (w / w.max()).astype(np.float32)
+        batch["valid"] = batch["valid"].astype(np.uint8)
+        batch["nvalid"] = batch["nvalid"].astype(np.uint8)
+        batch["_sampled_at"] = tuple(sampled_at)
+        return batch
+
+    def update_priorities(self, idx: np.ndarray, td_abs: np.ndarray,
+                          sampled_at=None) -> None:
+        if not self.prioritized:
+            return
+        idx = np.asarray(idx, np.int64)
+        shard_of = idx // self.cap_local
+        for s in range(self.num_shards):
+            pick = shard_of == s
+            if not pick.any():
+                continue
+            self.shards[s].update_priorities(
+                idx[pick] % self.cap_local, np.asarray(td_abs)[pick],
+                sampled_at=None if sampled_at is None else sampled_at[s])

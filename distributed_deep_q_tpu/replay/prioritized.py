@@ -88,6 +88,8 @@ class PrioritizedReplay:
     per-step |TD| feedback.
     """
 
+    prioritized = True
+
     def __init__(
         self,
         base,
@@ -112,6 +114,9 @@ class PrioritizedReplay:
     def __len__(self) -> int:
         return len(self.base)
 
+    def ready(self, learn_start: int) -> bool:
+        return self.base.ready(learn_start)
+
     @property
     def steps_added(self) -> int:
         return self.base.steps_added
@@ -132,7 +137,11 @@ class PrioritizedReplay:
         self.tree.set(idx, np.full(len(idx), self.max_priority ** self.alpha))
         return idx
 
-    def sample(self, batch_size: int) -> dict[str, np.ndarray]:
+    def sample_indices_weighted(
+            self, batch_size: int) -> tuple[np.ndarray, np.ndarray]:
+        """(slot indices, unnormalized IS weights) — the index-distribution
+        half of ``sample``, shared with the device-resident replay (which
+        gathers pixels in HBM instead of through ``base.gather``)."""
         idx = self.tree.sample_stratified(batch_size, self._rng)
         # Base-buffer validity (frame-stack window crossing the cursor,
         # truncation-only boundaries): redraw invalid lanes through the tree
@@ -150,14 +159,19 @@ class PrioritizedReplay:
                 idx[bad] = self.base.sample_indices(int(bad.sum()))
 
         self._samples += 1
-        batch = self.base.gather(idx)
-        # IS weights: w_i = (N · P(i))^-β, normalized by the batch max so
-        # updates only ever get scaled down (Schaul et al. §3.4).
+        # IS weights: w_i = (N · P(i))^-β (Schaul et al. §3.4); callers
+        # normalize by the batch max so updates only ever get scaled down.
         p = self.tree.get(idx)
         n = len(self.base)
         probs = np.maximum(p / max(self.tree.total, 1e-12), 1e-12)
         w = (n * probs) ** (-self.beta)
+        return idx, w
+
+    def sample(self, batch_size: int) -> dict[str, np.ndarray]:
+        idx, w = self.sample_indices_weighted(batch_size)
+        batch = self.base.gather(idx)
         batch["weight"] = (w / w.max()).astype(np.float32)
+        batch["_sampled_at"] = self.base.steps_added
         return batch
 
     # -- learner feedback --------------------------------------------------

@@ -38,6 +38,11 @@ import numpy as np
 class ReplayMemory:
     """Uniform ring buffer over explicit (s, a, r, s', discount) transitions."""
 
+    prioritized = False  # uniform sampling; PER wraps via replay/prioritized.py
+
+    def ready(self, learn_start: int) -> bool:
+        return len(self) >= learn_start
+
     def __init__(
         self,
         capacity: int,
@@ -127,6 +132,12 @@ class FrameStackReplay:
     Requires adds to be temporally contiguous (single writer stream).
     """
 
+    prioritized = False
+
+    def ready(self, learn_start: int) -> bool:
+        return (len(self) >= max(learn_start, self.stack + self.n_step + 1)
+                and self.valid_fraction() > 0)
+
     def __init__(
         self,
         capacity: int,
@@ -135,12 +146,19 @@ class FrameStackReplay:
         n_step: int = 1,
         gamma: float = 0.99,
         seed: int = 0,
+        store_frames: bool = True,
     ):
+        """``store_frames=False`` keeps only metadata (action/reward/done/
+        boundary + ring indices) — the mode used by the device-resident
+        replay (``replay/device_ring.py``), where frames live in HBM and
+        this class supplies index/validity/n-step composition via
+        ``gather_meta``."""
         self.capacity = int(capacity)
         self.stack = int(stack)
         self.n_step = int(n_step)
         self.gamma = float(gamma)
-        self.frames = np.zeros((capacity,) + tuple(frame_shape), np.uint8)
+        self.frames = (np.zeros((capacity,) + tuple(frame_shape), np.uint8)
+                       if store_frames else None)
         self.action = np.zeros(capacity, np.int32)
         self.reward = np.zeros(capacity, np.float32)
         self.done = np.zeros(capacity, bool)       # cuts bootstrap
@@ -161,7 +179,8 @@ class FrameStackReplay:
 
     def add(self, frame, action, reward, done, boundary=None) -> int:
         i = self._cursor
-        self.frames[i] = frame
+        if self.frames is not None:
+            self.frames[i] = frame
         self.action[i] = action
         self.reward[i] = reward
         self.done[i] = done
@@ -174,7 +193,8 @@ class FrameStackReplay:
     def add_batch(self, batch: Mapping[str, np.ndarray]) -> np.ndarray:
         n = len(batch["action"])
         idx = (self._cursor + np.arange(n)) % self.capacity
-        self.frames[idx] = batch["frame"]
+        if self.frames is not None:
+            self.frames[idx] = batch["frame"]
         self.action[idx] = batch["action"]
         self.reward[idx] = batch["reward"]
         self.done[idx] = batch["done"]
@@ -229,26 +249,33 @@ class FrameStackReplay:
                     f"truncated episodes shorter than stack-1+n_step")
         return idx
 
-    def gather(self, idx: np.ndarray) -> dict[str, np.ndarray]:
-        b = len(idx)
-        cap = self.capacity
+    def _stack_indices(self, idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(frame indices [B, stack] oldest-first, validity mask [B, stack]).
 
-        # --- observation stacks (zero frames that precede episode start) ---
-        # offsets k = stack-1 .. 0 (oldest first)
+        A frame at offset k is valid iff no episode boundary lies between it
+        and the anchor frame; invalid frames are zero-filled (episode-start
+        padding), matching ``FrameStacker.reset`` semantics.
+        """
+        b, cap = len(idx), self.capacity
         offs = np.arange(self.stack - 1, -1, -1)
         oidx = (idx[:, None] - offs[None, :]) % cap          # [B, stack]
-        # frame at i-k is part of this episode iff no episode boundary in
-        # (i-k-1 .. i-1]; walk newest→oldest accumulating boundary flags.
         prev_done = self.boundary[(oidx - 1) % cap]          # boundary before frame
-        # valid[b, j]: product over frames newer than j of (no done before them)
-        # computed right-to-left (newest frame always valid).
+        # valid[b, j]: product over frames newer than j of (no boundary
+        # before them), computed right-to-left (newest frame always valid).
         valid = np.ones((b, self.stack), bool)
         for j in range(self.stack - 2, -1, -1):
             valid[:, j] = valid[:, j + 1] & ~prev_done[:, j + 1]
-        obs = self.frames[oidx] * valid[..., None, None].astype(np.uint8)
+        return oidx, valid
 
-        # --- n-step return and discount ---
-        n = self.n_step
+    def gather_meta(self, idx: np.ndarray) -> dict[str, np.ndarray]:
+        """Everything ``gather`` needs except the frame pixels themselves:
+        stack indices + validity masks for s and s', the n-step return, and
+        the bootstrap discount. This is the host side of the device-resident
+        replay split — frames are gathered in HBM from these indices."""
+        b, cap, n = len(idx), self.capacity, self.n_step
+
+        oidx, valid = self._stack_indices(idx)
+
         steps = (idx[:, None] + np.arange(n)[None, :]) % cap  # [B, n]
         d = self.done[steps]                                   # [B, n]
         # continuing[b, k] = no done strictly before step k in the window
@@ -260,27 +287,31 @@ class FrameStackReplay:
         any_done = (d & continuing).any(axis=1)
         discount = np.where(any_done, 0.0, self._gammas[n]).astype(np.float32)
 
-        # --- next-state stacks (only matter where discount > 0) ---
-        next_idx = (idx + n) % cap
-        noidx = (next_idx[:, None] - offs[None, :]) % cap
-        nprev_done = self.boundary[(noidx - 1) % cap]
-        nvalid = np.ones((b, self.stack), bool)
-        for j in range(self.stack - 2, -1, -1):
-            nvalid[:, j] = nvalid[:, j + 1] & ~nprev_done[:, j + 1]
-        next_obs = self.frames[noidx] * nvalid[..., None, None].astype(np.uint8)
-
-        # frames-last layout for the CNN: [B, H, W, stack]
-        obs = np.moveaxis(obs, 1, -1)
-        next_obs = np.moveaxis(next_obs, 1, -1)
+        noidx, nvalid = self._stack_indices((idx + n) % cap)
         return {
-            "obs": obs,
+            "oidx": oidx.astype(np.int32),
+            "valid": valid,
+            "noidx": noidx.astype(np.int32),
+            "nvalid": nvalid,
             "action": self.action[idx],
             "reward": reward.astype(np.float32),
-            "next_obs": next_obs,
             "discount": discount,
             "weight": np.ones(b, np.float32),
             "index": idx.astype(np.int32),
         }
+
+    def gather(self, idx: np.ndarray) -> dict[str, np.ndarray]:
+        assert self.frames is not None, \
+            "gather() needs stored frames; metadata-only rings use gather_meta()"
+        m = self.gather_meta(idx)
+        obs = self.frames[m.pop("oidx")] \
+            * m.pop("valid")[..., None, None].astype(np.uint8)
+        next_obs = self.frames[m.pop("noidx")] \
+            * m.pop("nvalid")[..., None, None].astype(np.uint8)
+        # frames-last layout for the CNN: [B, H, W, stack]
+        m["obs"] = np.moveaxis(obs, 1, -1)
+        m["next_obs"] = np.moveaxis(next_obs, 1, -1)
+        return m
 
     def sample(self, batch_size: int) -> dict[str, np.ndarray]:
         return self.gather(self.sample_indices(batch_size))

@@ -24,6 +24,13 @@ from distributed_deep_q_tpu.parallel.learner import Learner, TrainState
 from distributed_deep_q_tpu.parallel.mesh import make_mesh
 
 
+def _strip_host_keys(batch: dict[str, Any]) -> dict[str, Any]:
+    """Drop host-only bookkeeping (slot indices, sample snapshots) before a
+    batch crosses into the jitted step."""
+    return {k: v for k, v in batch.items()
+            if k not in ("index", "_sampled_at")}
+
+
 class Solver:
     """Facade over (module, mesh, learner, state).
 
@@ -71,7 +78,19 @@ class Solver:
         they log / write priorities back, keeping dispatch pipelined.
         """
         self.state, metrics, td_abs = self.learner.train_step(
-            self.state, {k: v for k, v in batch.items() if k != "index"})
+            self.state, _strip_host_keys(batch))
+        out: dict[str, Any] = dict(metrics)
+        out["td_abs"] = td_abs
+        if "index" in batch:
+            out["index"] = batch["index"]
+        return out
+
+    def train_step_from_ring(self, ring, batch: dict[str, Any]) -> dict[str, Any]:
+        """One gradient step sampling pixels from the device-resident replay
+        ring (``replay/device_ring.py``): ``batch`` carries only indices,
+        masks, and scalars — frames are gathered in HBM inside the step."""
+        self.state, metrics, td_abs = self.learner.train_step_from_ring(
+            self.state, ring, _strip_host_keys(batch))
         out: dict[str, Any] = dict(metrics)
         out["td_abs"] = td_abs
         if "index" in batch:

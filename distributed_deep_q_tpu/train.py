@@ -14,8 +14,8 @@ from distributed_deep_q_tpu.actors.game import (
     FrameStacker, NStepAccumulator, make_env)
 from distributed_deep_q_tpu.config import Config
 from distributed_deep_q_tpu.metrics import Metrics, MovingAverage
-from distributed_deep_q_tpu.replay.prioritized import (
-    PrioritizedReplay, maybe_prioritize)
+from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
+from distributed_deep_q_tpu.replay.prioritized import maybe_prioritize
 from distributed_deep_q_tpu.replay.replay_memory import FrameStackReplay, ReplayMemory
 from distributed_deep_q_tpu.solver import Solver
 
@@ -61,15 +61,25 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
 
     pixel_env = env.obs_dtype == np.uint8
     if pixel_env:
-        replay = FrameStackReplay(
-            cfg.replay.capacity, env.obs_shape, cfg.env.stack,
-            cfg.replay.n_step, cfg.train.gamma, seed=cfg.train.seed)
+        if cfg.replay.device_resident:
+            # TPU-first data path: frames live in HBM, the step gathers
+            # stacks on device; PER (when enabled) is handled per shard
+            # inside DeviceFrameReplay
+            replay = DeviceFrameReplay(
+                cfg.replay, solver.mesh, env.obs_shape, cfg.env.stack,
+                cfg.train.gamma, seed=cfg.train.seed,
+                write_chunk=cfg.replay.write_chunk)
+        else:
+            replay = maybe_prioritize(FrameStackReplay(
+                cfg.replay.capacity, env.obs_shape, cfg.env.stack,
+                cfg.replay.n_step, cfg.train.gamma, seed=cfg.train.seed),
+                cfg.replay, seed=cfg.train.seed)
         stacker = FrameStacker(env.obs_shape, cfg.env.stack)
     else:
-        replay = ReplayMemory(cfg.replay.capacity, env.obs_shape,
-                              np.float32, seed=cfg.train.seed)
+        replay = maybe_prioritize(ReplayMemory(
+            cfg.replay.capacity, env.obs_shape, np.float32,
+            seed=cfg.train.seed), cfg.replay, seed=cfg.train.seed)
         nstep = NStepAccumulator(cfg.replay.n_step, cfg.train.gamma)
-    replay = maybe_prioritize(replay, cfg.replay, seed=cfg.train.seed)
 
     frame = env.reset()
     obs = stacker.reset(frame) if pixel_env else frame
@@ -111,13 +121,16 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
                 obs = frame
                 nstep.reset()
 
-        if (len(replay) >= cfg.replay.learn_start
+        if (replay.ready(cfg.replay.learn_start)
                 and t % cfg.train.train_every == 0):
             batch = replay.sample(cfg.replay.batch_size)
-            sampled_at = replay.steps_added
-            m = solver.train_step(batch)
+            sampled_at = batch.pop("_sampled_at", replay.steps_added)
+            if isinstance(replay, DeviceFrameReplay):
+                m = solver.train_step_from_ring(replay.ring, batch)
+            else:
+                m = solver.train_step(batch)
             gsteps += 1
-            if isinstance(replay, PrioritizedReplay):
+            if replay.prioritized:
                 # one-step-delayed priority write-back: materializing |TD|
                 # for the *previous* step is free by now (its device work is
                 # done), so the fresh step is never host-blocked
