@@ -53,6 +53,10 @@ def main() -> None:
                               write_chunk=1024)
     platform = jax.devices()[0].platform
     cfg.mesh.backend = "cpu" if platform == "cpu" else "tpu"
+    if cfg.mesh.backend == "cpu":
+        # backend already initialized by the jax.devices() probe: size the
+        # mesh to whatever virtual device count actually exists
+        cfg.mesh.num_fake_devices = max(len(jax.devices("cpu")), 1)
 
     solver = Solver(cfg)
     replay = DeviceFrameReplay(cfg.replay, solver.mesh, (84, 84), stack=4,

@@ -1,0 +1,345 @@
+"""Actor fleet + distributed training topology (SURVEY.md §1 L5, §7.2 step 3).
+
+Process shape (rebuilt from the reference's Spark-driver/worker layout [M]):
+one learner process (this module's ``train_distributed``) hosting the TPU
+mesh, the replay buffer, and the in-process ``ReplayFeed`` RPC service;
+N CPU actor *processes* (``actor_main``) each running env + ε-greedy policy
+against a locally-pulled θ, pushing transition chunks over the RPC boundary.
+The supervisor thread gives the failure-detection capability (SURVEY §5.3):
+actors are stateless, so a dead/hung actor (process exit or heartbeat
+silence) is simply restarted.
+
+Ape-X ε ladder: actor i uses ε_i = base^(1 + i·α/(N-1)) — a fixed spread of
+exploration rates across the fleet (Horgan et al. 2018) replacing the
+single-actor annealed schedule.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from distributed_deep_q_tpu.config import Config
+from distributed_deep_q_tpu.metrics import Metrics
+
+
+def actor_epsilon(i: int, n: int, base: float, alpha: float) -> float:
+    if n <= 1:
+        return base
+    return float(base ** (1.0 + i * alpha / (n - 1)))
+
+
+# ---------------------------------------------------------------------------
+# Actor process
+# ---------------------------------------------------------------------------
+
+
+def actor_main(cfg: Config, host: str, port: int, actor_id: int,
+               stop_event, max_env_steps: int = 0) -> None:
+    """One CPU actor: play with ε-greedy policy, ship transitions, pull θ.
+
+    Runs in a spawned process with JAX pinned to CPU (actors never touch the
+    accelerator — north star [M]). All communication goes through the
+    ``ReplayFeed`` boundary; the actor holds no learner state beyond its
+    local θ copy.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # late imports: after the platform pin, inside the child process
+    from distributed_deep_q_tpu.actors.game import (
+        FrameStacker, NStepAccumulator, make_env)
+    from distributed_deep_q_tpu.models.qnet import QNet
+    from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedClient
+
+    env = make_env(cfg.env, seed=cfg.train.seed + 1000 * (actor_id + 1))
+    cfg.net.num_actions = env.num_actions
+    qnet = QNet(cfg.net, seed=cfg.train.seed,
+                obs_dim=int(np.prod(env.obs_shape)))
+    client = ReplayFeedClient(host, port, actor_id=actor_id)
+    rng = np.random.default_rng(cfg.train.seed + 7777 * (actor_id + 1))
+    eps = actor_epsilon(actor_id, cfg.actors.num_actors,
+                        cfg.actors.eps_base, cfg.actors.eps_alpha)
+
+    pixel = env.obs_dtype == np.uint8
+    stacker = FrameStacker(env.obs_shape, cfg.env.stack) if pixel else None
+    nstep = (None if pixel else
+             NStepAccumulator(cfg.replay.n_step, cfg.train.gamma))
+
+    # outgoing chunk buffers
+    chunk: dict[str, list] = {k: [] for k in
+                              ("frame", "action", "reward", "done", "boundary",
+                               "obs", "next_obs", "discount")}
+    ep_returns: list[float] = []
+    episodes = 0
+    version = -1
+    steps = 0
+
+    def flush() -> None:
+        nonlocal episodes
+        if not chunk["action"]:
+            return
+        if pixel:
+            payload = {
+                "frame": np.stack(chunk["frame"]).astype(np.uint8),
+                "action": np.asarray(chunk["action"], np.int32),
+                "reward": np.asarray(chunk["reward"], np.float32),
+                "done": np.asarray(chunk["done"], bool),
+                "boundary": np.asarray(chunk["boundary"], bool),
+            }
+        else:
+            payload = {
+                "obs": np.stack(chunk["obs"]).astype(np.float32),
+                "action": np.asarray(chunk["action"], np.int32),
+                "reward": np.asarray(chunk["reward"], np.float32),
+                "next_obs": np.stack(chunk["next_obs"]).astype(np.float32),
+                "discount": np.asarray(chunk["discount"], np.float32),
+            }
+        payload["episodes"] = episodes
+        payload["ep_returns"] = np.asarray(ep_returns, np.float32)
+        client.add_transitions(**payload)
+        for v in chunk.values():
+            v.clear()
+        ep_returns.clear()
+        episodes = 0
+
+    frame = env.reset()
+    obs = stacker.reset(frame) if pixel else frame
+    ep_ret = 0.0
+    try:
+        while not stop_event.is_set():
+            if max_env_steps and steps >= max_env_steps:
+                break
+            # θ refresh over the RPC boundary (SURVEY §5.8: actors pull
+            # every ~param_sync_period env steps)
+            if steps % cfg.actors.param_sync_period == 0:
+                new_version, weights = client.get_params(have_version=version)
+                if weights is not None:
+                    qnet.set_weights(weights)
+                    version = new_version
+
+            if rng.random() < eps:
+                a = int(rng.integers(env.num_actions))
+            else:
+                a = qnet.argmax_action(np.asarray(obs))
+            next_frame, r, done, over = env.step(a)
+            ep_ret += r
+            steps += 1
+
+            if pixel:
+                chunk["frame"].append(frame)
+                chunk["action"].append(a)
+                chunk["reward"].append(r)
+                chunk["done"].append(done)
+                chunk["boundary"].append(over)
+                frame = next_frame
+                obs = stacker.push(frame)
+            else:
+                emitted = nstep.push(obs, a, r, next_frame, done)
+                if over and not done:
+                    emitted += nstep.flush_truncated(next_frame)
+                for (o, ac, rw, no, disc) in emitted:
+                    chunk["obs"].append(o)
+                    chunk["action"].append(ac)
+                    chunk["reward"].append(rw)
+                    chunk["next_obs"].append(no)
+                    chunk["discount"].append(disc)
+                obs = next_frame
+
+            if over:
+                ep_returns.append(ep_ret)
+                episodes += 1
+                ep_ret = 0.0
+                frame = env.reset()
+                if pixel:
+                    obs = stacker.reset(frame)
+                else:
+                    obs = frame
+                    nstep.reset()
+
+            if len(chunk["action"]) >= cfg.actors.send_batch:
+                flush()
+        flush()
+    except (ConnectionError, OSError):
+        pass  # learner gone; supervisor owns our lifecycle
+    finally:
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# Supervisor (failure detection, SURVEY §5.3)
+# ---------------------------------------------------------------------------
+
+
+class ActorSupervisor:
+    """Spawns the actor fleet and restarts dead or silent actors."""
+
+    def __init__(self, cfg: Config, host: str, port: int,
+                 heartbeat_timeout: float = 60.0):
+        self.cfg = cfg
+        self.host, self.port = host, port
+        self.heartbeat_timeout = heartbeat_timeout
+        self._ctx = mp.get_context("spawn")
+        self.stop_event = self._ctx.Event()
+        self.procs: dict[int, Any] = {}
+        self.spawned_at: dict[int, float] = {}
+        self.restarts = 0
+        self._watch: threading.Thread | None = None
+
+    def _spawn(self, i: int) -> None:
+        p = self._ctx.Process(
+            target=actor_main,
+            args=(self.cfg, self.host, self.port, i, self.stop_event),
+            name=f"actor-{i}", daemon=True)
+        p.start()
+        self.procs[i] = p
+        self.spawned_at[i] = time.monotonic()
+
+    def start(self) -> None:
+        for i in range(self.cfg.actors.num_actors):
+            self._spawn(i)
+
+    def watch(self, last_seen: dict[int, float],
+              poll_period: float = 2.0) -> None:
+        """Background liveness loop: restart on process death or heartbeat
+        silence (``last_seen`` is the ReplayFeed server's contact map)."""
+        def loop() -> None:
+            while not self.stop_event.is_set():
+                now = time.monotonic()
+                for i, p in list(self.procs.items()):
+                    dead = not p.is_alive()
+                    # silence is measured from the LATER of last contact and
+                    # last respawn, so a freshly-restarted child (which needs
+                    # seconds to import jax) isn't re-killed on stale stamps
+                    seen = max(last_seen.get(i, 0.0),
+                               self.spawned_at.get(i, 0.0))
+                    silent = seen > 0 and now - seen > self.heartbeat_timeout
+                    if dead or silent:
+                        if p.is_alive():
+                            p.terminate()
+                        p.join(timeout=5)
+                        self.restarts += 1
+                        self._spawn(i)
+                time.sleep(poll_period)
+
+        self._watch = threading.Thread(target=loop, name="actor-supervisor",
+                                       daemon=True)
+        self._watch.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self.stop_event.set()
+        for p in self.procs.values():
+            p.join(timeout=timeout)
+            if p.is_alive():
+                p.terminate()
+
+
+# ---------------------------------------------------------------------------
+# Distributed training loop (learner side)
+# ---------------------------------------------------------------------------
+
+
+def train_distributed(cfg: Config, metrics: Metrics | None = None,
+                      log_every: int = 500) -> dict:
+    """Actor fleet over RPC → replay → mesh learner; returns summary.
+
+    The learner samples/train-steps continuously once the buffer is ready;
+    actors stream transitions and pull θ through the ``ReplayFeed`` service.
+    Total work: ``cfg.train.total_steps`` grad steps (the distributed
+    topology's unit of progress is learner steps, matching the north-star
+    grad-steps/sec metric).
+    """
+    from distributed_deep_q_tpu.actors.game import make_env
+    from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
+    from distributed_deep_q_tpu.replay.prioritized import maybe_prioritize
+    from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
+    from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedServer
+    from distributed_deep_q_tpu.solver import Solver
+    from distributed_deep_q_tpu.train import evaluate
+
+    metrics = metrics or Metrics()
+    probe = make_env(cfg.env, seed=cfg.train.seed)
+    cfg.net.num_actions = probe.num_actions
+    obs_shape = probe.obs_shape
+    pixel = probe.obs_dtype == np.uint8
+    del probe
+
+    solver = Solver(cfg, obs_dim=int(np.prod(obs_shape)))
+    if pixel:
+        replay = DeviceFrameReplay(
+            cfg.replay, solver.mesh, obs_shape, cfg.env.stack,
+            cfg.train.gamma, seed=cfg.train.seed,
+            write_chunk=cfg.replay.write_chunk,
+            num_streams=cfg.actors.num_actors)
+    else:
+        replay = maybe_prioritize(
+            ReplayMemory(cfg.replay.capacity, obs_shape, np.float32,
+                         seed=cfg.train.seed),
+            cfg.replay, seed=cfg.train.seed)
+
+    server = ReplayFeedServer(replay, host=cfg.actors.host, port=0)
+    server.publish_params(solver.get_weights())
+    host, port = server.address
+
+    sup = ActorSupervisor(cfg, host, port)
+    sup.start()
+    sup.watch(server.last_seen)
+
+    pending = None
+    summary: dict = {}
+    try:
+        # wait for warm-up fill (actors are streaming meanwhile)
+        while not replay.ready(cfg.replay.learn_start):
+            time.sleep(0.05)
+        for gstep in range(1, cfg.train.total_steps + 1):
+            if isinstance(replay, DeviceFrameReplay):
+                # sample AND dispatch under the lock: a concurrent actor
+                # flush donates the current ring buffer, so the step must be
+                # enqueued before the ring handle can be invalidated
+                # (dispatch is µs; device execution stays async)
+                with server.replay_lock:
+                    batch = replay.sample(cfg.replay.batch_size)
+                    sampled_at = batch.pop("_sampled_at")
+                    m = solver.train_step_from_ring(replay.ring, batch)
+            else:
+                with server.replay_lock:
+                    batch = replay.sample(cfg.replay.batch_size)
+                    sampled_at = batch.pop("_sampled_at", replay.steps_added)
+                m = solver.train_step(batch)
+            metrics.count("grad_steps")
+
+            if replay.prioritized:
+                if pending is not None:
+                    with server.replay_lock:
+                        replay.update_priorities(
+                            pending[0], np.asarray(pending[1]),
+                            sampled_at=pending[2])
+                pending = (m["index"], m["td_abs"], sampled_at)
+
+            if gstep % cfg.actors.param_sync_period == 0:
+                server.publish_params(solver.get_weights())
+
+            if gstep % log_every == 0:
+                summary = {
+                    "loss": float(m["loss"]),
+                    "q_mean": float(m["q_mean"]),
+                    "return_avg100": server.mean_recent_return(),
+                    "env_steps": server.env_steps,
+                    "replay_size": len(replay),
+                    "grad_steps_per_s": metrics.rate("grad_steps"),
+                    "actor_restarts": sup.restarts,
+                }
+                metrics.log(gstep, **summary)
+    finally:
+        sup.stop()
+        server.close()
+
+    summary["final_return_avg100"] = server.mean_recent_return()
+    summary["eval_return"] = evaluate(solver, cfg)
+    summary["env_steps"] = server.env_steps
+    summary["actor_restarts"] = sup.restarts
+    summary["solver"] = solver
+    return summary

@@ -8,7 +8,7 @@ at ~160 ms over this container's TPU link vs a 0.2 ms train step. Instead:
 
 - **Frames enter HBM once, at actor rate.** A uint8 ring ``[capacity, H, W]``
   lives on the learner mesh, sharded over the ``dp`` axis (each device owns
-  a contiguous shard — Ape-X-style per-learner replay shards). Actor streams
+  a contiguous shard — Ape-X-style per-learner replay shards). Writers
   append in fixed-size chunks through a donated ``shard_map`` scatter.
 - **The train step gathers on device.** The host samples *indices* (uniform
   or PER sum-tree — pointer-chasing stays on host, SURVEY §7.3 item 2),
@@ -17,14 +17,22 @@ at ~160 ms over this container's TPU link vs a 0.2 ms train step. Instead:
   composition (gather + zero-masking + transpose) happens inside the jitted
   step, reading HBM at memory bandwidth.
 
-Sharding invariants:
-- Each episode is routed whole to one shard (``add`` advances the shard
-  pointer on episode boundaries; RPC streams pin ``stream → shard``), so
-  temporal adjacency — which frame-stacking relies on — holds per shard.
-- Sampling draws ``batch/D`` from every shard and concatenates in mesh
-  order, matching ``PartitionSpec('dp')`` row-block layout, so each device
-  gathers only from its local shard — no cross-device collective in the
-  data path.
+Layout — shards and stream slots:
+
+    device shard s owns ring rows [s·cap_local, (s+1)·cap_local)
+    each shard is split into ``subs_per_shard`` SLOTS of ``slot_cap`` rows
+    slot g (global id) lives on shard g % D at sub-ring g // D
+
+Frame stacking relies on temporal adjacency, so every slot has exactly ONE
+writer stream at a time. Stream i owns slots {g : g % num_streams == i} and
+cycles through them at episode boundaries; with fewer streams than shards a
+single stream still reaches every shard (episode round-robin), and with more
+streams than shards each shard hosts several sub-rings instead of
+interleaving writers. Sampling draws ``batch/D`` rows per shard (allocated
+across its slots by sampleable/priority mass) and concatenates in mesh
+order, matching ``PartitionSpec('dp')`` row-block layout — each device
+gathers only from its local shard, no cross-device collective in the data
+path.
 """
 
 from __future__ import annotations
@@ -37,7 +45,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_deep_q_tpu.config import ReplayConfig
 from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
-from distributed_deep_q_tpu.replay.prioritized import PrioritizedReplay
+from distributed_deep_q_tpu.replay.prioritized import (
+    SumTree, sample_valid_from_tree)
 from distributed_deep_q_tpu.replay.replay_memory import FrameStackReplay
 
 
@@ -62,6 +71,8 @@ class DeviceFrameReplay:
     are composed on device by the learner's ring train step.
     """
 
+    prioritized: bool
+
     def __init__(
         self,
         cfg: ReplayConfig,
@@ -71,34 +82,41 @@ class DeviceFrameReplay:
         gamma: float = 0.99,
         seed: int = 0,
         write_chunk: int = 64,
+        num_streams: int = 1,
     ):
         self.mesh = mesh
-        self.num_shards = mesh.shape[AXIS_DP]
-        d = self.num_shards
-        self.cap_local = int(cfg.capacity) // d
-        assert self.cap_local > 0 and cfg.batch_size % d == 0, \
-            f"capacity {cfg.capacity} / batch {cfg.batch_size} must split over {d} shards"
+        d = self.num_shards = mesh.shape[AXIS_DP]
+        self.num_streams = max(int(num_streams), 1)
+        self.subs_per_shard = -(-max(self.num_streams, d) // d)  # ceil
+        g = self.num_slots = self.subs_per_shard * d
+        self.slot_cap = int(cfg.capacity) // g
+        assert self.slot_cap > 0 and cfg.batch_size % d == 0, (
+            f"capacity {cfg.capacity} must split over {g} stream slots and "
+            f"batch {cfg.batch_size} over {d} shards")
+        self.cap_local = self.slot_cap * self.subs_per_shard
         self.capacity = self.cap_local * d
         self.stack = int(stack)
         self.frame_shape = tuple(frame_shape)
         self.write_chunk = int(write_chunk)
         self.prioritized = bool(cfg.prioritized)
+        self._cfg = cfg
+        self._rng = np.random.default_rng(seed)
 
-        def meta_ring(i: int) -> FrameStackReplay:
-            return FrameStackReplay(
-                self.cap_local, frame_shape, stack, cfg.n_step, gamma,
-                seed=seed + i, store_frames=False)
+        # per-slot metadata rings (single writer each → adjacency holds)
+        self.slots = [
+            FrameStackReplay(self.slot_cap, frame_shape, stack, cfg.n_step,
+                             gamma, seed=seed + i, store_frames=False)
+            for i in range(g)]
+        # per-slot priority trees with SHARED max-priority/β bookkeeping
+        self.trees = ([SumTree(self.slot_cap) for _ in range(g)]
+                      if self.prioritized else None)
+        self.max_priority = 1.0
+        self._samples = 0
 
-        if self.prioritized:
-            self.shards = [
-                PrioritizedReplay(
-                    meta_ring(i), alpha=cfg.priority_alpha,
-                    beta0=cfg.priority_beta0,
-                    beta_steps=cfg.priority_beta_steps,
-                    eps=cfg.priority_eps, seed=seed + 1000 + i)
-                for i in range(d)]
-        else:
-            self.shards = [meta_ring(i) for i in range(d)]
+        # stream → its slot cycle (stream i owns slots {g : g % streams == i})
+        self._slot_cycle = [[s for s in range(g) if s % self.num_streams == i]
+                            for i in range(self.num_streams)]
+        self._stream_pos = [0] * self.num_streams
 
         # HBM ring, allocated directly with its dp sharding (no host copy).
         ring_sharding = NamedSharding(mesh, P(AXIS_DP))
@@ -118,62 +136,104 @@ class DeviceFrameReplay:
                       out_specs=P(AXIS_DP)),
             donate_argnums=0)
 
-        # host-side staging: per-shard pending (local_idx, frame)
-        self._pending: list[list[tuple[int, np.ndarray]]] = [[] for _ in range(d)]
-        self._shard = 0  # episode-routing pointer for single-stream add()
+        # host staging: per-shard pending (in-shard offset, frame)
+        self._pending: list[list[tuple[int, np.ndarray]]] = [
+            [] for _ in range(d)]
+
+    # -- layout helpers -----------------------------------------------------
+
+    def _slot_base(self, slot: int) -> tuple[int, int]:
+        """(shard, in-shard base offset) of a slot's sub-ring."""
+        return slot % self.num_shards, (slot // self.num_shards) * self.slot_cap
+
+    def _global_index(self, slot: int, local: np.ndarray) -> np.ndarray:
+        shard, base = self._slot_base(slot)
+        return shard * self.cap_local + base + local
+
+    def _slot_of_global(self, gidx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """global ring row → (slot id, slot-local index)."""
+        shard, rem = gidx // self.cap_local, gidx % self.cap_local
+        sub, local = rem // self.slot_cap, rem % self.slot_cap
+        return sub * self.num_shards + shard, local
 
     # -- bookkeeping --------------------------------------------------------
 
-    def _meta(self, s: int) -> FrameStackReplay:
-        sh = self.shards[s]
-        return sh.base if isinstance(sh, PrioritizedReplay) else sh
-
     def __len__(self) -> int:
-        return sum(len(self._meta(s)) for s in range(self.num_shards))
-
-    def ready(self, learn_start: int) -> bool:
-        """True when sampling can proceed: aggregate fill reached AND every
-        shard can form transitions (sample draws batch/D from *each* shard,
-        and episodes route whole to shards, so early on some shards may
-        still be empty — SURVEY §7.3 item 6)."""
-        if len(self) < learn_start:
-            return False
-        return all(
-            len(m) > m.stack + m.n_step and m.valid_fraction() > 0
-            for m in (self._meta(s) for s in range(self.num_shards)))
+        return sum(len(m) for m in self.slots)
 
     @property
     def steps_added(self) -> int:
-        return sum(self._meta(s).steps_added for s in range(self.num_shards))
+        return sum(m.steps_added for m in self.slots)
+
+    def _sampleable(self, slot: int) -> int:
+        """Sampleable transition mass of a slot (0 until it can sample)."""
+        m = self.slots[slot]
+        window = m.stack + m.n_step + 1
+        if len(m) <= window or m.valid_fraction() <= 0:
+            return 0
+        return len(m) - window
+
+    def ready(self, learn_start: int) -> bool:
+        """True when sampling can proceed: aggregate fill reached AND every
+        shard has at least one slot with sampleable transitions (sample
+        draws batch/D from *each* shard — SURVEY §7.3 item 6)."""
+        if len(self) < learn_start:
+            return False
+        per_shard = [0] * self.num_shards
+        for g in range(self.num_slots):
+            per_shard[g % self.num_shards] += self._sampleable(g)
+        return all(mass > 0 for mass in per_shard)
+
+    @property
+    def beta(self) -> float:
+        cfg = self._cfg
+        frac = min(self._samples / max(cfg.priority_beta_steps, 1), 1.0)
+        return cfg.priority_beta0 + frac * (1.0 - cfg.priority_beta0)
 
     # -- write path ---------------------------------------------------------
 
-    def add(self, frame, action, reward, done, boundary=None) -> int:
-        """Single-stream add; episodes route whole to one shard and the
-        shard pointer advances at each episode boundary."""
-        s = self._shard
-        i = self.shards[s].add(None, action, reward, done, boundary=boundary)
-        self._pending[s].append((i, np.asarray(frame, np.uint8)))
-        episode_over = done if boundary is None else boundary
-        if episode_over:
-            self._shard = (s + 1) % self.num_shards
-        if len(self._pending[s]) >= self.write_chunk:
-            self.flush()
-        return s * self.cap_local + i
+    def _add_row(self, stream: int, frame, action, reward, done,
+                 boundary) -> int:
+        cycle = self._slot_cycle[stream]
+        slot = cycle[self._stream_pos[stream] % len(cycle)]
+        i = self.slots[slot].add(None, action, reward, done, boundary=boundary)
+        if self.prioritized:
+            self.trees[slot].set(
+                np.asarray([i]),
+                np.asarray([self.max_priority ** self._cfg.priority_alpha]))
+        shard, base = self._slot_base(slot)
+        self._pending[shard].append((base + i, np.asarray(frame, np.uint8)))
+        over = done if boundary is None else boundary
+        if over:
+            # episode finished → move this stream to its next slot, so one
+            # stream eventually reaches every shard it owns
+            self._stream_pos[stream] += 1
+        return self._global_index(slot, np.asarray(i))
 
-    def add_batch(self, batch, stream: int = 0) -> np.ndarray:
-        """RPC-fed contiguous chunk from one actor stream (→ one shard)."""
-        s = stream % self.num_shards
-        idx = self.shards[s].add_batch(
-            {k: v for k, v in batch.items() if k != "frame"} | {
-                "action": batch["action"], "reward": batch["reward"],
-                "done": batch["done"],
-                "boundary": batch.get("boundary", batch["done"])})
-        for i, f in zip(idx, batch["frame"]):
-            self._pending[s].append((int(i), np.asarray(f, np.uint8)))
+    def add(self, frame, action, reward, done, boundary=None) -> int:
+        """Single-stream add (in-process training loop)."""
+        idx = self._add_row(0, frame, action, reward, done, boundary)
         if max(len(p) for p in self._pending) >= self.write_chunk:
             self.flush()
-        return idx + s * self.cap_local
+        return int(idx)
+
+    def add_batch(self, batch, stream: int = 0) -> np.ndarray:
+        """Contiguous chunk from one actor stream (RPC path). The chunk may
+        contain episode boundaries; rows route to the stream's current slot,
+        which advances at each boundary."""
+        assert 0 <= stream < self.num_streams, \
+            f"stream {stream} outside configured num_streams={self.num_streams}"
+        n = len(batch["action"])
+        done = np.asarray(batch["done"], bool)
+        boundary = np.asarray(batch.get("boundary", batch["done"]), bool)
+        out = np.empty(n, np.int64)
+        for r in range(n):
+            out[r] = self._add_row(
+                stream, batch["frame"][r], batch["action"][r],
+                batch["reward"][r], bool(done[r]), bool(boundary[r]))
+        if max(len(p) for p in self._pending) >= self.write_chunk:
+            self.flush()
+        return out
 
     def flush(self) -> None:
         """Push all staged frames to HBM in fixed-shape chunks.
@@ -197,43 +257,101 @@ class DeviceFrameReplay:
 
     # -- sample path --------------------------------------------------------
 
+    def _allocate(self, quota: int, masses: list[float]) -> list[int]:
+        """Split ``quota`` draws across slots ∝ mass (largest remainder)."""
+        total = sum(masses)
+        if total <= 0:
+            return [0] * len(masses)
+        exact = [quota * m / total for m in masses]
+        counts = [int(e) for e in exact]
+        rem = quota - sum(counts)
+        for i in sorted(range(len(exact)),
+                        key=lambda i: exact[i] - counts[i], reverse=True)[:rem]:
+            counts[i] += 1
+        return counts
+
     def sample(self, batch_size: int) -> dict[str, np.ndarray]:
         """Index batch (no pixels): per-shard draws concatenated in mesh
         order so ``P('dp')`` row-blocks land on the owning devices."""
         self.flush()
         d = self.num_shards
         per = batch_size // d
-        parts, weights, sampled_at = [], [], []
+        parts: list[dict[str, np.ndarray]] = []
+        probs: list[np.ndarray] = []
+        self._samples += 1
         for s in range(d):
-            sh = self.shards[s]
+            shard_slots = [g for g in range(self.num_slots)
+                           if g % d == s]
             if self.prioritized:
-                idx, w = sh.sample_indices_weighted(per)
+                masses = [self.trees[g].total if self._sampleable(g) else 0.0
+                          for g in shard_slots]
             else:
-                idx, w = sh.sample_indices(per), np.ones(per)
-            m = self._meta(s).gather_meta(idx)
-            m["index"] = (idx + s * self.cap_local).astype(np.int32)
-            parts.append(m)
-            weights.append(w)
-            sampled_at.append(self._meta(s).steps_added)
-        batch = {k: np.concatenate([p[k] for p in parts])
-                 for k in parts[0]}
-        w = np.concatenate(weights)
-        batch["weight"] = (w / w.max()).astype(np.float32)
+                masses = [float(self._sampleable(g)) for g in shard_slots]
+            counts = self._allocate(per, masses)
+            assert sum(counts) == per, \
+                f"shard {s} has no sampleable slot (gate on ready())"
+            for g, c in zip(shard_slots, counts):
+                if c == 0:
+                    continue
+                meta = self.slots[g]
+                if self.prioritized:
+                    local = sample_valid_from_tree(
+                        self.trees[g], meta, c, self._rng)
+                    p = self.trees[g].get(local)
+                else:
+                    local = meta.sample_indices(c)
+                    p = np.ones(c)
+                m = meta.gather_meta(local)
+                _, base = self._slot_base(g)
+                for key in ("oidx", "noidx"):
+                    m[key] = (m[key] + base).astype(np.int32)
+                m["index"] = self._global_index(g, local).astype(np.int64)
+                m["_slot"] = np.full(c, g, np.int32)
+                m["_p"] = p
+                parts.append(m)
+        batch = {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+
+        if self.prioritized:
+            # global IS weights: P(i) = p_i / Σ_all mass, N = global fill
+            total_mass = sum(t.total for t in self.trees)
+            n = len(self)
+            pr = np.maximum(batch.pop("_p") / max(total_mass, 1e-12), 1e-12)
+            w = (n * pr) ** (-self.beta)
+            batch["weight"] = (w / w.max()).astype(np.float32)
+        else:
+            batch.pop("_p")
+            batch["weight"] = np.ones(batch_size, np.float32)
+        batch.pop("_slot")
         batch["valid"] = batch["valid"].astype(np.uint8)
         batch["nvalid"] = batch["nvalid"].astype(np.uint8)
-        batch["_sampled_at"] = tuple(sampled_at)
+        batch["index"] = batch["index"].astype(np.int32)
+        batch["_sampled_at"] = tuple(m.steps_added for m in self.slots)
         return batch
+
+    # -- learner feedback ---------------------------------------------------
 
     def update_priorities(self, idx: np.ndarray, td_abs: np.ndarray,
                           sampled_at=None) -> None:
         if not self.prioritized:
             return
-        idx = np.asarray(idx, np.int64)
-        shard_of = idx // self.cap_local
-        for s in range(self.num_shards):
-            pick = shard_of == s
-            if not pick.any():
-                continue
-            self.shards[s].update_priorities(
-                idx[pick] % self.cap_local, np.asarray(td_abs)[pick],
-                sampled_at=None if sampled_at is None else sampled_at[s])
+        gidx = np.asarray(idx, np.int64)
+        td = np.abs(np.asarray(td_abs, np.float64)) + self._cfg.priority_eps
+        slot_ids, local = self._slot_of_global(gidx)
+        for g in np.unique(slot_ids):
+            pick = slot_ids == g
+            li, lt = local[pick], td[pick]
+            meta = self.slots[g]
+            if sampled_at is not None:
+                # stale-slot guard (same ring math as PrioritizedReplay):
+                # drop indices recycled by writes since the sample snapshot
+                written = meta.steps_added - sampled_at[g]
+                if written >= self.slot_cap:
+                    continue
+                if written > 0:
+                    cursor_then = sampled_at[g] % self.slot_cap
+                    fresh = ((li - cursor_then) % self.slot_cap) >= written
+                    li, lt = li[fresh], lt[fresh]
+                    if li.size == 0:
+                        continue
+            self.trees[g].set(li, lt ** self._cfg.priority_alpha)
+            self.max_priority = max(self.max_priority, float(lt.max()))

@@ -80,6 +80,29 @@ class SumTree:
         return idx - self.size
 
 
+def sample_valid_from_tree(tree: SumTree, base, count: int,
+                           rng: np.random.Generator) -> np.ndarray:
+    """Proportional draw of ``count`` valid slot indices from ``tree``.
+
+    Base-buffer validity (frame-stack window crossing the cursor,
+    truncation-only boundaries): redraw invalid lanes through the tree a few
+    times, then fall back to the base's uniform valid sampler. Shared by
+    ``PrioritizedReplay`` and the device ring's per-slot trees.
+    """
+    idx = tree.sample_stratified(count, rng)
+    invalid_fn = getattr(base, "_invalid", None)
+    if invalid_fn is not None:
+        bad = invalid_fn(idx)
+        for _ in range(8):
+            if not bad.any():
+                break
+            idx[bad] = tree.sample_stratified(int(bad.sum()), rng)
+            bad = invalid_fn(idx)
+        if bad.any():
+            idx[bad] = base.sample_indices(int(bad.sum()))
+    return idx
+
+
 class PrioritizedReplay:
     """Proportional PER over any base buffer with add/gather/index surface.
 
@@ -142,22 +165,8 @@ class PrioritizedReplay:
         """(slot indices, unnormalized IS weights) — the index-distribution
         half of ``sample``, shared with the device-resident replay (which
         gathers pixels in HBM instead of through ``base.gather``)."""
-        idx = self.tree.sample_stratified(batch_size, self._rng)
-        # Base-buffer validity (frame-stack window crossing the cursor,
-        # truncation-only boundaries): redraw invalid lanes through the tree
-        # a few times, then fall back to the base's uniform valid sampler.
-        invalid_fn = getattr(self.base, "_invalid", None)
-        if invalid_fn is not None:
-            bad = invalid_fn(idx)
-            for _ in range(8):
-                if not bad.any():
-                    break
-                idx[bad] = self.tree.sample_stratified(
-                    int(bad.sum()), self._rng)
-                bad = invalid_fn(idx)
-            if bad.any():
-                idx[bad] = self.base.sample_indices(int(bad.sum()))
-
+        idx = sample_valid_from_tree(self.tree, self.base, batch_size,
+                                     self._rng)
         self._samples += 1
         # IS weights: w_i = (N · P(i))^-β (Schaul et al. §3.4); callers
         # normalize by the batch max so updates only ever get scaled down.

@@ -1,0 +1,133 @@
+"""Wire protocol — length-prefixed binary messages of numpy arrays/scalars.
+
+The reference's distribution plane is "parameter-server RPC — HTTP or raw-TCP
+transport of serialized numpy arrays" (SURVEY.md §2.3 [M][P][R]); this is the
+rebuilt equivalent for the actor↔learner boundary (SURVEY §5.8: the DCN
+plane). Pure stdlib (struct + socket): no pickle (no code execution on
+receive), no HTTP framing overhead, zero-copy numpy buffer sends.
+
+A message is a dict[str, ndarray | int | float | bool | str | None]:
+
+    u32 LE  total payload length
+    u16 LE  item count
+    per item:
+      u16 LE keylen, key utf-8
+      u8 kind  (0 ndarray, 1 int64, 2 float64, 3 str, 4 bool, 5 none)
+      ndarray: u8 dtypelen, dtype str, u8 ndim, u32×ndim shape, u64 nbytes, raw
+      int64/float64: 8 bytes; str: u32 len + utf-8; bool: u8
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Any
+
+import numpy as np
+
+MAX_MESSAGE = 1 << 30  # 1 GiB sanity cap
+
+_KIND_NDARRAY, _KIND_INT, _KIND_FLOAT, _KIND_STR, _KIND_BOOL, _KIND_NONE = range(6)
+
+
+def encode(msg: dict[str, Any]) -> bytes:
+    parts: list[bytes] = [struct.pack("<H", len(msg))]
+    for key, val in msg.items():
+        kb = key.encode()
+        parts.append(struct.pack("<H", len(kb)))
+        parts.append(kb)
+        if isinstance(val, np.ndarray):
+            db = str(val.dtype).encode()
+            val = np.ascontiguousarray(val)
+            parts.append(struct.pack("<BB", _KIND_NDARRAY, len(db)))
+            parts.append(db)
+            parts.append(struct.pack("<B", val.ndim))
+            parts.append(struct.pack(f"<{val.ndim}I", *val.shape))
+            parts.append(struct.pack("<Q", val.nbytes))
+            parts.append(val.tobytes())
+        elif isinstance(val, bool):  # before int: bool is an int subclass
+            parts.append(struct.pack("<BB", _KIND_BOOL, int(val)))
+        elif isinstance(val, (int, np.integer)):
+            parts.append(struct.pack("<Bq", _KIND_INT, int(val)))
+        elif isinstance(val, (float, np.floating)):
+            parts.append(struct.pack("<Bd", _KIND_FLOAT, float(val)))
+        elif isinstance(val, str):
+            sb = val.encode()
+            parts.append(struct.pack("<BI", _KIND_STR, len(sb)))
+            parts.append(sb)
+        elif val is None:
+            parts.append(struct.pack("<B", _KIND_NONE))
+        else:
+            raise TypeError(f"unsupported message value {key}={type(val)}")
+    payload = b"".join(parts)
+    return struct.pack("<I", len(payload)) + payload
+
+
+def decode(payload: bytes) -> dict[str, Any]:
+    msg: dict[str, Any] = {}
+    (count,), off = struct.unpack_from("<H", payload), 2
+    for _ in range(count):
+        (klen,) = struct.unpack_from("<H", payload, off)
+        off += 2
+        key = payload[off:off + klen].decode()
+        off += klen
+        (kind,) = struct.unpack_from("<B", payload, off)
+        off += 1
+        if kind == _KIND_NDARRAY:
+            (dlen,) = struct.unpack_from("<B", payload, off)
+            off += 1
+            dtype = np.dtype(payload[off:off + dlen].decode())
+            off += dlen
+            (ndim,) = struct.unpack_from("<B", payload, off)
+            off += 1
+            shape = struct.unpack_from(f"<{ndim}I", payload, off)
+            off += 4 * ndim
+            (nbytes,) = struct.unpack_from("<Q", payload, off)
+            off += 8
+            arr = np.frombuffer(payload, dtype, count=nbytes // dtype.itemsize,
+                                offset=off).reshape(shape)
+            msg[key] = arr.copy()  # own the memory past the recv buffer
+            off += nbytes
+        elif kind == _KIND_INT:
+            (msg[key],) = struct.unpack_from("<q", payload, off)
+            off += 8
+        elif kind == _KIND_FLOAT:
+            (msg[key],) = struct.unpack_from("<d", payload, off)
+            off += 8
+        elif kind == _KIND_STR:
+            (slen,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            msg[key] = payload[off:off + slen].decode()
+            off += slen
+        elif kind == _KIND_BOOL:
+            (b,) = struct.unpack_from("<B", payload, off)
+            msg[key] = bool(b)
+            off += 1
+        elif kind == _KIND_NONE:
+            msg[key] = None
+        else:
+            raise ValueError(f"bad message kind {kind}")
+    return msg
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("socket closed mid-message")
+        got += r
+    return bytes(buf)
+
+
+def send_msg(sock: socket.socket, msg: dict[str, Any]) -> None:
+    sock.sendall(encode(msg))
+
+
+def recv_msg(sock: socket.socket) -> dict[str, Any]:
+    (length,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if length > MAX_MESSAGE:
+        raise ValueError(f"message of {length} bytes exceeds cap")
+    return decode(_recv_exact(sock, length))

@@ -1,0 +1,197 @@
+"""``ReplayFeed`` — the actor↔learner RPC service (SURVEY.md §5.8 [M]).
+
+The reference keeps CPU actors feeding the replay buffer "over the same RPC
+boundary" while the learner owns the accelerator (north star [M]). This is
+that boundary, rebuilt: a threaded raw-TCP service colocated with the
+learner, speaking ``rpc/protocol.py`` messages:
+
+- ``add_transitions`` — actors push transition chunks (pixel streams carry
+  frames + episode flags; vector streams carry explicit n-step transitions).
+  Each actor stream id pins to a replay shard so the device ring's temporal
+  adjacency invariant holds.
+- ``get_params``      — actors pull fresh θ every ~``param_sync_period`` env
+  steps (replaces the reference PS pull path; there is NO gradient plane
+  over this boundary — ``lax.pmean`` over ICI replaced the push path).
+- ``heartbeat`` / ``stats`` — failure detection (SURVEY §5.3) and the
+  env-steps/episode-return counters the north-star metrics need.
+
+Thread-safety: one lock guards the replay buffer (writer threads vs the
+learner's sampler) and a second guards the published parameter snapshot.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from distributed_deep_q_tpu.rpc.protocol import recv_msg, send_msg
+
+
+class ReplayFeedServer:
+    """Threaded TCP server wrapping a replay buffer + parameter snapshot."""
+
+    def __init__(self, replay, host: str = "127.0.0.1", port: int = 0):
+        self.replay = replay
+        # RLock: stats/mean_recent_return may be read under an already-held
+        # guard (e.g. inside the add_transitions/stats handlers)
+        self.replay_lock = threading.RLock()
+        self._params: dict[str, Any] | None = None
+        self._params_version = 0
+        self._params_lock = threading.Lock()
+        self.last_seen: dict[int, float] = {}
+        self.env_steps = 0
+        self.episodes = 0
+        self.returns: list[float] = []
+
+        self._sock = socket.create_server((host, port))
+        self.address = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="replayfeed-accept", daemon=True)
+        self._accept_thread.start()
+
+    # -- learner-side API ---------------------------------------------------
+
+    def publish_params(self, weights: list[np.ndarray]) -> int:
+        """Install a new θ snapshot for actors to pull; returns version."""
+        with self._params_lock:
+            self._params_version += 1
+            self._params = {f"w{i}": np.asarray(w)
+                            for i, w in enumerate(weights)}
+            self._params["n"] = len(weights)
+            self._params["version"] = self._params_version
+            return self._params_version
+
+    def mean_recent_return(self, k: int = 100) -> float:
+        with self.replay_lock:
+            tail = self.returns[-k:]
+        return float(np.mean(tail)) if tail else float("nan")
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- wire loop ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not self._stop.is_set():
+                req = recv_msg(conn)
+                send_msg(conn, self._dispatch(req))
+        except (ConnectionError, OSError):
+            pass  # actor went away; supervisor handles liveness
+        finally:
+            conn.close()
+
+    def _dispatch(self, req: dict[str, Any]) -> dict[str, Any]:
+        method = req.get("method")
+        actor_id = int(req.get("actor_id", -1))
+        if actor_id >= 0:
+            self.last_seen[actor_id] = time.monotonic()
+
+        if method == "add_transitions":
+            with self.replay_lock:
+                if "frame" in req:  # pixel stream → frame/device ring
+                    n = len(req["action"])
+                    batch = {k: req[k] for k in
+                             ("frame", "action", "reward", "done", "boundary")
+                             if k in req}
+                    if _takes_stream(self.replay):
+                        self.replay.add_batch(batch, stream=actor_id)
+                    else:
+                        self.replay.add_batch(batch)
+                else:  # explicit n-step transitions (vector envs)
+                    n = len(req["action"])
+                    self.replay.add_batch(
+                        {k: req[k] for k in
+                         ("obs", "action", "reward", "next_obs", "discount")})
+                self.env_steps += n
+                self.episodes += int(req.get("episodes", 0))
+                for r in np.atleast_1d(req.get("ep_returns",
+                                               np.zeros(0, np.float32))):
+                    self.returns.append(float(r))
+            return {"ok": True, "env_steps": self.env_steps}
+
+        if method == "get_params":
+            with self._params_lock:
+                if self._params is None:
+                    return {"version": 0}
+                if req.get("have_version") == self._params_version:
+                    return {"version": self._params_version}  # no-op refresh
+                return dict(self._params)
+
+        if method == "heartbeat":
+            return {"ok": True}
+
+        if method == "stats":
+            with self.replay_lock:
+                return {
+                    "env_steps": self.env_steps,
+                    "episodes": self.episodes,
+                    "replay_size": len(self.replay),
+                    "mean_return": self.mean_recent_return(),
+                }
+
+        return {"error": f"unknown method {method!r}"}
+
+
+def _takes_stream(replay) -> bool:
+    import inspect
+    try:
+        return "stream" in inspect.signature(replay.add_batch).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+class ReplayFeedClient:
+    """Actor-side stub: one persistent connection, blocking request/reply."""
+
+    def __init__(self, host: str, port: int, actor_id: int = 0,
+                 timeout: float = 30.0):
+        self.actor_id = int(actor_id)
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def call(self, method: str, **kwargs: Any) -> dict[str, Any]:
+        with self._lock:
+            send_msg(self._sock, {"method": method,
+                                  "actor_id": self.actor_id, **kwargs})
+            return recv_msg(self._sock)
+
+    def add_transitions(self, **batch: Any) -> dict[str, Any]:
+        return self.call("add_transitions", **batch)
+
+    def get_params(self, have_version: int = -1):
+        """Returns (version, weights-or-None if unchanged/unpublished)."""
+        resp = self.call("get_params", have_version=have_version)
+        version = resp["version"]
+        if "n" not in resp:
+            return version, None
+        return version, [resp[f"w{i}"] for i in range(resp["n"])]
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

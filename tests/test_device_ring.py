@@ -99,12 +99,14 @@ def test_device_batch_shard_locality_dp8():
             local_ring[batch["oidx"][rows]]
             * batch["valid"][rows][..., None, None], 1, -1)
         np.testing.assert_array_equal(obs_dev[rows], expect)
-        # metadata rows come from shard s's own buffer
-        meta = dev._meta(s)
-        local_idx = batch["index"][rows].astype(np.int64) - s * cap_l
-        assert ((0 <= local_idx) & (local_idx < cap_l)).all()
-        np.testing.assert_array_equal(batch["action"][rows],
-                                      meta.action[local_idx])
+        # metadata rows come from shard s's own slot buffers
+        gidx = batch["index"][rows].astype(np.int64)
+        assert ((s * cap_l <= gidx) & (gidx < (s + 1) * cap_l)).all()
+        slots, local = dev._slot_of_global(gidx)
+        for r in range(len(gidx)):
+            assert int(slots[r]) % dp == s
+            assert batch["action"][rows][r] == \
+                dev.slots[int(slots[r])].action[int(local[r])]
 
 
 def test_ring_contents_match_stream_dp1():
@@ -144,8 +146,10 @@ def test_sharded_episode_routing():
     dev = DeviceFrameReplay(cfg, mesh, (4, 4), stack=2, seed=0)
     _play_stream(dev, None, 200, episode_len=7, frame_shape=(4, 4))
     # episodes round-robin across 4 shards: all shards received data
-    for s in range(4):
-        assert len(dev._meta(s)) > 0
+    filled = [0] * 4
+    for g in range(dev.num_slots):
+        filled[g % 4] += len(dev.slots[g])
+    assert all(f > 0 for f in filled)
     assert len(dev) == 200
 
 
@@ -178,18 +182,67 @@ def test_per_over_device_ring():
     _play_stream(dev, None, 200, episode_len=11, frame_shape=(4, 4))
     batch = dev.sample(16)
     sampled_at = batch.pop("_sampled_at")
-    assert len(sampled_at) == 2
+    assert len(sampled_at) == dev.num_slots
     assert batch["weight"].max() == pytest.approx(1.0)
-    # priorities route back to the owning shard
+    # priorities route back to the owning slot tree
     dev.update_priorities(batch["index"], np.full(16, 50.0),
                           sampled_at=sampled_at)
-    seen = np.zeros(2, bool)
-    for g, td in zip(batch["index"], np.full(16, 50.0)):
-        s = g // dev.cap_local
-        p = dev.shards[s].tree.get(np.asarray([g % dev.cap_local]))[0]
-        assert p == pytest.approx(50.0 + dev.shards[s].eps, rel=1e-6)
-        seen[s] = True
+    seen = np.zeros(dev.num_slots, bool)
+    for g in batch["index"].astype(np.int64):
+        slot, local = dev._slot_of_global(np.asarray([g]))
+        p = dev.trees[int(slot[0])].get(local)[0]
+        assert p == pytest.approx(50.0 + cfg.priority_eps, rel=1e-6)
+        seen[int(slot[0])] = True
     assert seen.all()
+
+
+def test_multi_stream_subrings_no_interleave():
+    """More streams than shards: each stream writes its own sub-ring, so
+    concurrent actor chunks never interleave within a metadata ring."""
+    mesh = _mesh(2)
+    cfg = ReplayConfig(capacity=512, batch_size=8)
+    dev = DeviceFrameReplay(cfg, mesh, (4, 4), stack=2, seed=0,
+                            num_streams=4)
+    assert dev.num_slots == 4 and dev.subs_per_shard == 2
+    # interleave chunks from 4 streams, each stream's frames tagged by value
+    for rnd in range(6):
+        for stream in range(4):
+            n = 10
+            dev.add_batch({
+                "frame": np.full((n, 4, 4), 10 * stream + rnd, np.uint8),
+                "action": np.full(n, stream, np.int32),
+                "reward": np.zeros(n, np.float32),
+                "done": np.asarray([i == n - 1 for i in range(n)]),
+            }, stream=stream)
+    dev.flush()
+    ring = np.asarray(dev.ring)
+    # every slot's metadata holds exactly one stream's actions, and its ring
+    # region holds only that stream's frame tags
+    for g in range(4):
+        meta = dev.slots[g]
+        n = len(meta)
+        assert n == 60  # single writer, contiguous
+        streams = np.unique(meta.action[:n])
+        assert len(streams) == 1
+        shard, base = dev._slot_base(g)
+        region = ring[shard * dev.cap_local + base:
+                      shard * dev.cap_local + base + n]
+        assert set(np.unique(region)) <= {10 * streams[0] + r
+                                          for r in range(6)}
+
+
+def test_single_stream_reaches_all_shards():
+    """Fewer streams than shards: one stream cycles its slots per episode,
+    so warm-up fills every shard instead of deadlocking ready()."""
+    mesh = _mesh(4)
+    cfg = ReplayConfig(capacity=1024, batch_size=8)
+    dev = DeviceFrameReplay(cfg, mesh, (4, 4), stack=2, seed=0,
+                            num_streams=1)
+    for ep in range(8):
+        for t in range(30):
+            dev.add(np.zeros((4, 4), np.uint8), 0, 0.0, done=(t == 29))
+    assert dev.ready(100)
+    dev.sample(8)  # draws 2 per shard without raising
 
 
 def test_train_loop_with_device_ring_fake_atari():

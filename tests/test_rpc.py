@@ -1,0 +1,191 @@
+"""RPC boundary tests: wire protocol round-trips, ReplayFeed service
+semantics over loopback, and the distributed actor/learner topology
+end-to-end (including the kill-an-actor fault-injection test, SURVEY §5.3)."""
+
+import multiprocessing as mp
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_deep_q_tpu.rpc.protocol import (
+    decode, encode, recv_msg, send_msg)
+from distributed_deep_q_tpu.rpc.replay_server import (
+    ReplayFeedClient, ReplayFeedServer)
+from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
+
+
+def test_protocol_roundtrip_types():
+    msg = {
+        "arr_u8": np.arange(24, dtype=np.uint8).reshape(2, 3, 4),
+        "arr_f32": np.linspace(0, 1, 7, dtype=np.float32),
+        "arr_bool": np.array([True, False, True]),
+        "arr_scalar": np.asarray(3.5, np.float64).reshape(()),
+        "an_int": -42,
+        "a_float": 3.25,
+        "a_str": "hello ε-greedy",
+        "a_bool": True,
+        "nothing": None,
+    }
+    out = decode(encode(msg)[4:])
+    assert set(out) == set(msg)
+    for k in ("arr_u8", "arr_f32", "arr_bool", "arr_scalar"):
+        np.testing.assert_array_equal(out[k], msg[k])
+        assert out[k].dtype == msg[k].dtype
+    assert out["an_int"] == -42 and isinstance(out["an_int"], int)
+    assert out["a_float"] == 3.25
+    assert out["a_str"] == "hello ε-greedy"
+    assert out["a_bool"] is True
+    assert out["nothing"] is None
+
+
+def test_protocol_over_socket():
+    a, b = socket.socketpair()
+    msg = {"x": np.random.default_rng(0).standard_normal((100, 100))}
+    t = threading.Thread(target=send_msg, args=(a, msg))
+    t.start()
+    out = recv_msg(b)
+    t.join()
+    np.testing.assert_array_equal(out["x"], msg["x"])
+    a.close(), b.close()
+
+
+def test_replay_feed_add_and_params():
+    replay = ReplayMemory(256, (4,), np.float32)
+    server = ReplayFeedServer(replay)
+    host, port = server.address
+    client = ReplayFeedClient(host, port, actor_id=3)
+    try:
+        n = 32
+        resp = client.add_transitions(
+            obs=np.ones((n, 4), np.float32),
+            action=np.zeros(n, np.int32),
+            reward=np.ones(n, np.float32),
+            next_obs=np.ones((n, 4), np.float32),
+            discount=np.full(n, 0.99, np.float32),
+            episodes=2, ep_returns=np.asarray([10.0, 20.0], np.float32))
+        assert resp["ok"] and resp["env_steps"] == n
+        assert len(replay) == n
+        assert server.episodes == 2
+        assert server.mean_recent_return() == pytest.approx(15.0)
+        assert 3 in server.last_seen
+
+        # params: none yet → version 0
+        version, weights = client.get_params()
+        assert version == 0 and weights is None
+        ws = [np.arange(6, dtype=np.float32).reshape(2, 3), np.ones(3)]
+        server.publish_params(ws)
+        version, weights = client.get_params()
+        assert version == 1
+        np.testing.assert_array_equal(weights[0], ws[0])
+        np.testing.assert_array_equal(weights[1], ws[1])
+        # no-op refresh when version unchanged
+        version, weights = client.get_params(have_version=1)
+        assert version == 1 and weights is None
+
+        stats = client.call("stats")
+        assert stats["env_steps"] == n and stats["replay_size"] == n
+    finally:
+        client.close()
+        server.close()
+
+
+@pytest.mark.slow
+def test_distributed_cartpole_end_to_end():
+    """Full topology on loopback: 2 actor processes + learner, vector env."""
+    from distributed_deep_q_tpu.actors.supervisor import train_distributed
+    from distributed_deep_q_tpu.config import cartpole_config
+
+    cfg = cartpole_config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.num_fake_devices = 2
+    cfg.train.total_steps = 150          # grad steps in distributed mode
+    cfg.replay.learn_start = 200
+    cfg.replay.batch_size = 32
+    cfg.actors.num_actors = 2
+    cfg.actors.send_batch = 16
+    cfg.actors.param_sync_period = 50
+    summary = train_distributed(cfg, log_every=50)
+    assert summary["solver"].step == 150
+    assert summary["env_steps"] > 200
+    assert np.isfinite(summary["loss"])
+    assert summary["actor_restarts"] == 0
+
+
+@pytest.mark.slow
+def test_distributed_pixel_device_ring_end_to_end():
+    """Actors streaming FakeAtari frames over RPC into the device ring while
+    the learner trains from it — exercises stream sub-rings, the locked
+    sample+dispatch (ring donation race), and PER priority write-back."""
+    from distributed_deep_q_tpu.actors.supervisor import train_distributed
+    from distributed_deep_q_tpu.config import pong_config, ReplayConfig
+
+    cfg = pong_config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.num_fake_devices = 2
+    cfg.env.id = "fake"
+    cfg.env.kind = "fake_atari"
+    cfg.env.frame_shape = (36, 36)
+    cfg.net.frame_shape = (36, 36)
+    cfg.net.compute_dtype = "float32"
+    cfg.replay = ReplayConfig(capacity=4096, batch_size=16, learn_start=300,
+                              n_step=2, prioritized=True, write_chunk=16)
+    cfg.train.total_steps = 60
+    cfg.train.target_update_period = 10
+    cfg.actors.num_actors = 3   # 3 streams > 2 shards → sub-rings in play
+    cfg.actors.send_batch = 20
+    cfg.actors.param_sync_period = 25
+    summary = train_distributed(cfg, log_every=20)
+    assert summary["solver"].step == 60
+    assert np.isfinite(summary["loss"])
+    assert summary["env_steps"] >= 300
+
+
+@pytest.mark.slow
+def test_supervisor_restarts_killed_actor():
+    """Fault injection (SURVEY §5.3): kill an actor mid-run; the supervisor
+    must detect the death and respawn it, and training must keep going."""
+    from distributed_deep_q_tpu.actors.supervisor import (
+        ActorSupervisor, train_distributed)
+    from distributed_deep_q_tpu.config import cartpole_config
+
+    # run the topology manually so we can reach into the fleet
+    from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
+    from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedServer
+
+    cfg = cartpole_config()
+    cfg.mesh.backend = "cpu"
+    cfg.actors.num_actors = 1
+    cfg.actors.send_batch = 8
+
+    replay = ReplayMemory(10_000, (4,), np.float32)
+    server = ReplayFeedServer(replay)
+    host, port = server.address
+    sup = ActorSupervisor(cfg, host, port)
+    try:
+        sup.start()
+        sup.watch(server.last_seen, poll_period=0.2)
+        deadline = time.monotonic() + 60
+        while len(replay) < 50 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert len(replay) >= 50, "actor never fed the buffer"
+
+        victim = sup.procs[0]
+        victim.kill()
+        deadline = time.monotonic() + 60
+        while sup.restarts == 0 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert sup.restarts >= 1, "supervisor never restarted the dead actor"
+
+        # the replacement actor feeds the buffer again
+        size_after_restart = len(replay)
+        deadline = time.monotonic() + 60
+        while len(replay) <= size_after_restart + 20 \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert len(replay) > size_after_restart + 20
+    finally:
+        sup.stop()
+        server.close()
