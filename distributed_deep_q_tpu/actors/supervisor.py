@@ -290,6 +290,11 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
 
     pending = None
     summary: dict = {}
+    from distributed_deep_q_tpu.utils.checkpoint import maybe_checkpointer
+    ckpt = maybe_checkpointer(cfg.train)
+    if ckpt and cfg.train.resume and ckpt.latest_step() is not None:
+        solver.state, _ = ckpt.restore(solver.state)
+        server.publish_params(solver.get_weights())
     try:
         # wait for warm-up fill (actors are streaming meanwhile)
         while not replay.ready(cfg.replay.learn_start):
@@ -321,6 +326,9 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
 
             if gstep % cfg.actors.param_sync_period == 0:
                 server.publish_params(solver.get_weights())
+
+            if ckpt and gstep % cfg.train.checkpoint_every == 0:
+                ckpt.save(solver.state, extra={"env_steps": server.env_steps})
 
             if gstep % log_every == 0:
                 summary = {

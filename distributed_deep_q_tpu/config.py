@@ -69,6 +69,10 @@ class TrainConfig:
     target_update_period: int = 500  # "every C pulls: θ⁻ ← θ" (SURVEY §3.1 [M])
     double_dqn: bool = False
     huber_delta: float = 1.0
+    # R2D2 sequence path: invertible value rescaling h(x) on targets, and
+    # the η mixing of max/mean |TD| for per-sequence priorities
+    value_rescale: bool = True
+    priority_eta: float = 0.9
     grad_clip_norm: float = 10.0
     total_steps: int = 50_000
     # env steps per gradient step when running single-process
@@ -79,7 +83,8 @@ class TrainConfig:
     # use the fused Pallas TD-loss kernel on TPU
     use_pallas_loss: bool = False
     checkpoint_dir: str = ""
-    checkpoint_every: int = 0
+    checkpoint_every: int = 0  # grad steps between Orbax snapshots
+    resume: bool = False       # restore newest snapshot before training
 
 
 @dataclass

@@ -19,6 +19,19 @@ import sys
 from distributed_deep_q_tpu.config import add_config_flags, config_from_args
 
 
+def _maybe_restore(solver, cfg) -> int | None:
+    """Load the newest Orbax snapshot into ``solver`` when a checkpoint dir
+    is configured; returns the restored step (None if nothing to restore)."""
+    if not cfg.train.checkpoint_dir:
+        return None
+    from distributed_deep_q_tpu.utils.checkpoint import Checkpointer
+    ckpt = Checkpointer(cfg.train.checkpoint_dir)
+    if ckpt.latest_step() is None:
+        return None
+    solver.state, _ = ckpt.restore(solver.state)
+    return solver.step
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="distributed_deep_q_tpu")
     parser.add_argument("mode", choices=["train", "eval", "play"],
@@ -65,10 +78,11 @@ def main(argv: list[str] | None = None) -> int:
         env = make_env(cfg.env, seed=cfg.train.seed)
         cfg.net.num_actions = env.num_actions
         solver = Solver(cfg, obs_dim=int(np.prod(env.obs_shape)))
+        restored = _maybe_restore(solver, cfg)
         ret = evaluate(solver, cfg)
         print(json.dumps({"mode": "eval", "eval_return": ret,
                           "episodes": cfg.train.eval_episodes,
-                          "note": "untrained parameters unless restored"}))
+                          "restored_step": restored}))
         return 0
 
     if args.mode == "play":
@@ -78,6 +92,7 @@ def main(argv: list[str] | None = None) -> int:
         env = make_env(cfg.env, seed=cfg.train.seed)
         cfg.net.num_actions = env.num_actions
         solver = Solver(cfg, obs_dim=int(np.prod(env.obs_shape)))
+        _maybe_restore(solver, cfg)
         rng = np.random.default_rng(cfg.train.seed)
         stacker = (FrameStacker(env.obs_shape, cfg.env.stack)
                    if env.obs_dtype == np.uint8 else None)

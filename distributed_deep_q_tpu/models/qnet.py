@@ -177,8 +177,12 @@ def build_qnet(cfg: NetConfig) -> nn.Module:
 
 def example_obs(cfg: NetConfig, batch_size: int = 1,
                 obs_dim: int = 4) -> np.ndarray:
-    """A zero observation batch with the right shape/dtype for ``cfg``."""
-    if cfg.kind == "mlp":
+    """A zero observation batch with the right shape/dtype for ``cfg``.
+
+    MLP nets (and r2d2 with the mlp torso) take flat [B, obs_dim] vectors;
+    conv torsos take [B, H, W, stack] uint8 frames.
+    """
+    if cfg.kind == "mlp" or (cfg.kind == "r2d2" and cfg.torso == "mlp"):
         return np.zeros((batch_size, obs_dim), np.float32)
     h, w = cfg.frame_shape
     return np.zeros((batch_size, h, w, cfg.stack), np.uint8)

@@ -62,6 +62,41 @@ def dqn_loss(
     return loss, jnp.abs(jax.lax.stop_gradient(td))
 
 
+def value_rescale(x: jax.Array, eps: float = 1e-3) -> jax.Array:
+    """R2D2 invertible value rescaling h(x) = sign(x)(√(|x|+1)−1) + εx
+    (Kapturowski et al. 2019, from Pohlen et al. 2018) — lets the recurrent
+    learner train on unclipped rewards with bounded targets."""
+    return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + eps * x
+
+
+def value_rescale_inv(x: jax.Array, eps: float = 1e-3) -> jax.Array:
+    """Analytic inverse of ``value_rescale``."""
+    return jnp.sign(x) * (
+        jnp.square((jnp.sqrt(1.0 + 4.0 * eps * (jnp.abs(x) + 1.0 + eps))
+                    - 1.0) / (2.0 * eps)) - 1.0)
+
+
+def sequence_bellman_targets(
+    reward: jax.Array,          # [B, T]
+    discount: jax.Array,        # [B, T]: γ·(1-done) per step
+    q_next_target: jax.Array,   # [B, T, A] target net Q(s_{t+1})
+    q_next_online: jax.Array | None = None,  # [B, T, A] for Double-DQN
+    double: bool = True,
+    rescale: bool = True,
+) -> jax.Array:
+    """Per-step targets h(r + γ·h⁻¹(Q⁻(s', a*))) over a sequence window."""
+    if double:
+        assert q_next_online is not None
+        a_star = jnp.argmax(q_next_online, axis=-1)
+    else:
+        a_star = jnp.argmax(q_next_target, axis=-1)
+    q_sel = jnp.take_along_axis(q_next_target, a_star[..., None],
+                                axis=-1)[..., 0]
+    if rescale:
+        return value_rescale(reward + discount * value_rescale_inv(q_sel))
+    return reward + discount * q_sel
+
+
 def sequence_dqn_loss(
     q: jax.Array,         # [B, T, A] online Q over the training window
     actions: jax.Array,   # [B, T] int32

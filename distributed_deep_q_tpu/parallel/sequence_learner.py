@@ -1,0 +1,227 @@
+"""Recurrent (R2D2) sequence learner — config 5 [M].
+
+Same synchronous-DP shape as ``parallel/learner.py`` (shard_map over the
+``dp`` mesh axis, ``lax.pmean`` gradient allreduce over ICI, replicated
+on-device target refresh), with the R2D2 sequence step inside one XLA
+program:
+
+1. **Burn-in**: the LSTM runs over the first ``burn_in`` steps from the
+   *stored* carry to refresh recurrent state; ``stop_gradient`` on the
+   resulting carry keeps burn-in out of the backward pass (SURVEY §7.3
+   item 3). The unroll is a flax ``nn.RNN`` = lifted ``lax.scan`` — one
+   fused scan body, compiler-friendly, no Python unrolling.
+2. **Train window**: online and target nets unroll over the remaining
+   ``T+1`` observations; per-step Double-DQN targets with R2D2 invertible
+   value rescaling (``ops/losses.sequence_bellman_targets``).
+3. **Masked loss + priority**: ``sequence_dqn_loss`` masks padding and
+   burn-in, and returns the mixed max/mean |TD| per-sequence priority for
+   PER write-back.
+
+Batch sequences are sharded over ``dp`` on the batch axis — the scope
+decision recorded in SURVEY §5.7: sequence *length* stays ≤ O(100) steps so
+sequence-axis parallelism (ring attention / Ulysses-style CP) is
+deliberately not applicable; scale comes from sharding the batch of
+sequences.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax, shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_deep_q_tpu.config import ReplayConfig, TrainConfig
+from distributed_deep_q_tpu.ops.losses import (
+    sequence_bellman_targets, sequence_dqn_loss)
+from distributed_deep_q_tpu.parallel.learner import TrainState, make_optimizer
+from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
+
+
+class SequenceLearner:
+    """Owns the sharded R2D2 train step for recurrent Q-nets."""
+
+    def __init__(self, module, cfg: TrainConfig, replay_cfg: ReplayConfig,
+                 mesh):
+        self.module = module
+        self.cfg = cfg
+        self.burn_in = int(replay_cfg.burn_in)
+        self.mesh = mesh
+        self.opt = make_optimizer(cfg)
+        self._replicated = NamedSharding(mesh, P())
+        self._train_step = self._build_train_step()
+
+    def init_state(self, params: Any) -> TrainState:
+        state = TrainState(
+            params=params,
+            target_params=jax.tree.map(jnp.copy, params),
+            opt_state=self.opt.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+        return jax.device_put(state, self._replicated)
+
+    def _build_train_step(self):
+        cfg, burn = self.cfg, self.burn_in
+        module, opt = self.module, self.opt
+
+        def apply_seq(params, obs, carry):
+            return module.apply({"params": params}, obs, carry)
+
+        def step_fn(state: TrainState, batch: dict[str, jax.Array]):
+            obs = batch["obs"]                    # [B, T_total+1, ...]
+            carry0 = (batch["init_c"], batch["init_h"])
+
+            def loss_fn(params):
+                # burn-in from the stored carry; gradients cut at the seam
+                if burn > 0:
+                    _, carry_on = apply_seq(params, obs[:, :burn], carry0)
+                    carry_on = lax.stop_gradient(carry_on)
+                    _, carry_tg = apply_seq(state.target_params,
+                                            obs[:, :burn], carry0)
+                else:
+                    carry_on = carry_tg = carry0
+
+                # train window: T+1 obs → q for steps and for bootstraps
+                q_all, _ = apply_seq(params, obs[:, burn:], carry_on)
+                q_tgt_all, _ = apply_seq(state.target_params, obs[:, burn:],
+                                         carry_tg)
+                q = q_all[:, :-1]                           # [B, T, A]
+                q_next_online = lax.stop_gradient(q_all[:, 1:])
+                q_next_target = q_tgt_all[:, 1:]
+
+                targets = sequence_bellman_targets(
+                    batch["reward"][:, burn:], batch["discount"][:, burn:],
+                    q_next_target, q_next_online,
+                    double=cfg.double_dqn, rescale=cfg.value_rescale)
+                loss, priority = sequence_dqn_loss(
+                    q, batch["action"][:, burn:], targets,
+                    batch["mask"][:, burn:], batch["weight"],
+                    cfg.huber_delta, eta=cfg.priority_eta)
+                return loss, (priority, q)
+
+            (loss, (priority, q)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
+
+            grads = lax.pmean(grads, AXIS_DP)
+            loss = lax.pmean(loss, AXIS_DP)
+            q_mean = lax.pmean(jnp.mean(q), AXIS_DP)
+
+            updates, opt_state = opt.update(grads, state.opt_state,
+                                            state.params)
+            params = optax.apply_updates(state.params, updates)
+            step = state.step + 1
+            target_params = lax.cond(
+                step % cfg.target_update_period == 0,
+                lambda: params,
+                lambda: state.target_params,
+            )
+            new_state = TrainState(params, target_params, opt_state, step)
+            metrics = {
+                "loss": loss,
+                "q_mean": q_mean,
+                "grad_norm": optax.global_norm(grads),
+            }
+            return new_state, metrics, priority
+
+        sharded = shard_map(
+            step_fn,
+            mesh=self.mesh,
+            in_specs=(P(), P(AXIS_DP)),
+            out_specs=(P(), P(), P(AXIS_DP)),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=0)
+
+    def train_step(self, state: TrainState, batch: dict[str, Any]):
+        """One synchronous DP step over a [B, T_total(+1)] sequence batch;
+        returns (state, metrics, per-sequence priority [B])."""
+        return self._train_step(state, batch)
+
+
+class SequenceSolver:
+    """Reference ``Solver`` surface for the recurrent pipeline.
+
+    Mirrors ``solver.Solver`` (train_step / q_values / act / weight IO [M])
+    with recurrent state threading for the actor path.
+    """
+
+    def __init__(self, config, obs_dim: int = 4, backend: str | None = None):
+        import dataclasses
+
+        from distributed_deep_q_tpu.models.qnet import (
+            QNet, build_qnet, init_params)
+        from distributed_deep_q_tpu.parallel.mesh import make_mesh
+        from distributed_deep_q_tpu.solver import _strip_host_keys
+
+        assert config.net.kind == "r2d2", "SequenceSolver is for r2d2 nets"
+        if backend is not None:
+            config = dataclasses.replace(
+                config, mesh=dataclasses.replace(config.mesh, backend=backend))
+        self.config = config
+        self.backend = config.mesh.backend
+        self.mesh = make_mesh(config.mesh)
+        self.module = build_qnet(config.net)
+        self.learner = SequenceLearner(self.module, config.train,
+                                       config.replay, self.mesh)
+        params = init_params(self.module, config.net, config.train.seed,
+                             obs_dim)
+        self.state: TrainState = self.learner.init_state(params)
+        self._treedef = jax.tree_util.tree_structure(params)
+        self._strip = _strip_host_keys
+        self._fwd = jax.jit(
+            lambda p, o, c: self.module.apply({"params": p}, o, c))
+
+    @property
+    def step(self) -> int:
+        return int(self.state.step)
+
+    def train_step(self, batch: dict[str, Any]) -> dict[str, Any]:
+        self.state, metrics, priority = self.learner.train_step(
+            self.state, self._strip(batch))
+        out: dict[str, Any] = dict(metrics)
+        out["td_abs"] = priority  # per-sequence priority for PER write-back
+        if "index" in batch:
+            out["index"] = batch["index"]
+        return out
+
+    # -- recurrent actor path ----------------------------------------------
+
+    def initial_state(self, batch_size: int = 1):
+        from distributed_deep_q_tpu.models.qnet import R2d2QNet
+        return R2d2QNet(self.config.net.num_actions,
+                        self.config.net.lstm_size).initial_state(batch_size)
+
+    def q_values(self, obs: np.ndarray, carry):
+        """obs [B, ...] single step → (q [B, A], next carry)."""
+        q, carry = self._fwd(self.state.params, np.asarray(obs)[:, None],
+                             carry)
+        return np.asarray(q[:, 0]), carry
+
+    def act(self, obs: np.ndarray, carry, epsilon: float,
+            rng: np.random.Generator):
+        """ε-greedy with recurrent state; returns (action, next carry).
+
+        The carry ALWAYS advances (even on random actions) so stored actor
+        state matches what the policy network saw — required for the
+        stored-state burn-in strategy to be meaningful."""
+        q, carry = self.q_values(obs[None], carry)
+        if rng.random() < epsilon:
+            return int(rng.integers(self.config.net.num_actions)), carry
+        return int(np.argmax(q[0])), carry
+
+    # -- weight IO ----------------------------------------------------------
+
+    def get_weights(self) -> list[np.ndarray]:
+        return [np.asarray(x)
+                for x in jax.tree_util.tree_leaves(self.state.params)]
+
+    def update(self, weights: list[np.ndarray]) -> None:
+        params = jax.tree_util.tree_unflatten(self._treedef, list(weights))
+        params = jax.device_put(params, self.learner._replicated)
+        self.state = self.state.replace(params=params)
+
+    set_weights = update

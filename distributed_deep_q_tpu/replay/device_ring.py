@@ -46,7 +46,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_deep_q_tpu.config import ReplayConfig
 from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
 from distributed_deep_q_tpu.replay.prioritized import (
-    SumTree, sample_valid_from_tree)
+    SumTree, beta_at, filter_stale, sample_valid_from_tree)
 from distributed_deep_q_tpu.replay.replay_memory import FrameStackReplay
 
 
@@ -186,9 +186,8 @@ class DeviceFrameReplay:
 
     @property
     def beta(self) -> float:
-        cfg = self._cfg
-        frac = min(self._samples / max(cfg.priority_beta_steps, 1), 1.0)
-        return cfg.priority_beta0 + frac * (1.0 - cfg.priority_beta0)
+        return beta_at(self._samples, self._cfg.priority_beta0,
+                       self._cfg.priority_beta_steps)
 
     # -- write path ---------------------------------------------------------
 
@@ -277,7 +276,6 @@ class DeviceFrameReplay:
         d = self.num_shards
         per = batch_size // d
         parts: list[dict[str, np.ndarray]] = []
-        probs: list[np.ndarray] = []
         self._samples += 1
         for s in range(d):
             shard_slots = [g for g in range(self.num_slots)
@@ -340,18 +338,10 @@ class DeviceFrameReplay:
         for g in np.unique(slot_ids):
             pick = slot_ids == g
             li, lt = local[pick], td[pick]
-            meta = self.slots[g]
             if sampled_at is not None:
-                # stale-slot guard (same ring math as PrioritizedReplay):
-                # drop indices recycled by writes since the sample snapshot
-                written = meta.steps_added - sampled_at[g]
-                if written >= self.slot_cap:
+                li, lt = filter_stale(li, lt, self.slots[g].steps_added,
+                                      sampled_at[g], self.slot_cap)
+                if li.size == 0:
                     continue
-                if written > 0:
-                    cursor_then = sampled_at[g] % self.slot_cap
-                    fresh = ((li - cursor_then) % self.slot_cap) >= written
-                    li, lt = li[fresh], lt[fresh]
-                    if li.size == 0:
-                        continue
             self.trees[g].set(li, lt ** self._cfg.priority_alpha)
             self.max_priority = max(self.max_priority, float(lt.max()))

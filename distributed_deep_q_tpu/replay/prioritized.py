@@ -80,6 +80,34 @@ class SumTree:
         return idx - self.size
 
 
+def beta_at(samples: int, beta0: float, beta_steps: int) -> float:
+    """IS-correction exponent annealed linearly β₀ → 1 over ``beta_steps``
+    sample() calls (Schaul et al. §3.4)."""
+    frac = min(samples / max(beta_steps, 1), 1.0)
+    return beta0 + frac * (1.0 - beta0)
+
+
+def filter_stale(idx: np.ndarray, vals: np.ndarray, steps_added: int,
+                 sampled_at: int, capacity: int):
+    """Drop (idx, vals) pairs whose ring slot was recycled by writes since
+    the ``sampled_at`` snapshot.
+
+    The write cursor of every ring here is ``steps_added % capacity``, so a
+    slot is stale iff its distance ahead of the snapshot cursor is inside
+    the since-written window. Returns filtered (idx, vals); both empty when
+    a full buffer turnover happened. Shared by the host PER buffer, the
+    device ring's per-slot trees, and the sequence replay.
+    """
+    written = steps_added - sampled_at
+    if written <= 0:
+        return idx, vals
+    if written >= capacity:
+        return idx[:0], vals[:0]
+    cursor_then = sampled_at % capacity
+    fresh = ((idx - cursor_then) % capacity) >= written
+    return idx[fresh], vals[fresh]
+
+
 def sample_valid_from_tree(tree: SumTree, base, count: int,
                            rng: np.random.Generator) -> np.ndarray:
     """Proportional draw of ``count`` valid slot indices from ``tree``.
@@ -146,8 +174,7 @@ class PrioritizedReplay:
 
     @property
     def beta(self) -> float:
-        frac = min(self._samples / max(self.beta_steps, 1), 1.0)
-        return self.beta0 + frac * (1.0 - self.beta0)
+        return beta_at(self._samples, self.beta0, self.beta_steps)
 
     def add(self, *args, **kwargs) -> int:
         i = self.base.add(*args, **kwargs)
@@ -198,16 +225,10 @@ class PrioritizedReplay:
         idx = np.asarray(idx, np.int64)
         td = np.abs(np.asarray(td_abs, np.float64)) + self.eps
         if sampled_at is not None:
-            written = self.base.steps_added - sampled_at
-            if written > 0:
-                cap = self.base.capacity
-                if written >= cap:
-                    return
-                cursor_then = sampled_at % cap
-                fresh = ((idx - cursor_then) % cap) >= written
-                idx, td = idx[fresh], td[fresh]
-                if idx.size == 0:
-                    return
+            idx, td = filter_stale(idx, td, self.base.steps_added,
+                                   sampled_at, self.base.capacity)
+            if idx.size == 0:
+                return
         self.tree.set(idx, td ** self.alpha)
         self.max_priority = max(self.max_priority, float(td.max()))
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 import socket
 import threading
 import time
+from collections import deque
 from typing import Any
 
 import numpy as np
@@ -45,12 +46,12 @@ class ReplayFeedServer:
         self.last_seen: dict[int, float] = {}
         self.env_steps = 0
         self.episodes = 0
-        self.returns: list[float] = []
+        # bounded: only the recent tail is ever read (mean_recent_return)
+        self.returns: deque[float] = deque(maxlen=1000)
 
         self._sock = socket.create_server((host, port))
         self.address = self._sock.getsockname()
         self._stop = threading.Event()
-        self._threads: list[threading.Thread] = []
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="replayfeed-accept", daemon=True)
         self._accept_thread.start()
@@ -69,7 +70,7 @@ class ReplayFeedServer:
 
     def mean_recent_return(self, k: int = 100) -> float:
         with self.replay_lock:
-            tail = self.returns[-k:]
+            tail = list(self.returns)[-k:]
         return float(np.mean(tail)) if tail else float("nan")
 
     def close(self) -> None:
@@ -87,10 +88,8 @@ class ReplayFeedServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return  # socket closed
-            t = threading.Thread(target=self._serve, args=(conn,),
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)

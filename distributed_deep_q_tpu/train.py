@@ -18,6 +18,7 @@ from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
 from distributed_deep_q_tpu.replay.prioritized import maybe_prioritize
 from distributed_deep_q_tpu.replay.replay_memory import FrameStackReplay, ReplayMemory
 from distributed_deep_q_tpu.solver import Solver
+from distributed_deep_q_tpu.utils.checkpoint import maybe_checkpointer
 
 
 def epsilon_at(step: int, cfg) -> float:
@@ -52,6 +53,8 @@ def evaluate(solver: Solver, cfg: Config, episodes: int | None = None,
 def train_single_process(cfg: Config, metrics: Metrics | None = None,
                          log_every: int = 1_000) -> dict:
     """Run config-1-style training; returns final summary metrics."""
+    if cfg.net.kind == "r2d2":
+        return train_recurrent(cfg, metrics, log_every)
     metrics = metrics or Metrics()
     env = make_env(cfg.env, seed=cfg.train.seed)
     cfg.net.num_actions = env.num_actions
@@ -87,6 +90,10 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
     summary: dict = {}
     pending = None  # (index, td_abs, sampled_at) awaiting PER write-back
     gsteps = 0
+    ckpt = maybe_checkpointer(cfg.train)
+    if ckpt and cfg.train.resume and ckpt.latest_step() is not None:
+        solver.state, _ = ckpt.restore(solver.state)
+        gsteps = solver.step
 
     for t in range(1, cfg.train.total_steps + 1):
         eps = epsilon_at(t, cfg.actors)
@@ -140,6 +147,8 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
                                              sampled_at=pending[2])
                 pending = (m["index"], m["td_abs"], sampled_at)
             metrics.count("grad_steps")
+            if ckpt and gsteps % cfg.train.checkpoint_every == 0:
+                ckpt.save(solver.state, extra={"env_steps": t})
             # host-side counter: reading solver.step would sync on the
             # just-dispatched device step every iteration
             if gsteps % log_every == 0:
@@ -154,7 +163,144 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
         if (cfg.train.eval_every and t % cfg.train.eval_every == 0):
             metrics.log(solver.step, eval_return=evaluate(solver, cfg))
 
+    if ckpt:
+        ckpt.save(solver.state, extra={"env_steps": cfg.train.total_steps},
+                  wait=True)
     summary["final_return_avg100"] = ep_returns.value
     summary["eval_return"] = evaluate(solver, cfg)
+    summary["solver"] = solver
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# Recurrent (R2D2) single-process loop — config 5 [M]
+# ---------------------------------------------------------------------------
+
+
+def evaluate_recurrent(solver, cfg: Config, episodes: int | None = None,
+                       seed: int = 10_000) -> float:
+    """Greedy rollouts threading LSTM state through the episode."""
+    env = make_env(cfg.env, seed=seed)
+    rng = np.random.default_rng(seed)
+    episodes = episodes or cfg.train.eval_episodes
+    pixel = env.obs_dtype == np.uint8
+    stacker = FrameStacker(env.obs_shape, cfg.env.stack) if pixel else None
+    returns = []
+    for _ in range(episodes):
+        obs, ep_ret, over = env.reset(), 0.0, False
+        if stacker:
+            obs = stacker.reset(obs)
+        carry = solver.initial_state(1)
+        while not over:
+            a, carry = solver.act(np.asarray(obs), carry,
+                                  cfg.actors.eval_eps, rng)
+            frame, r, _, over = env.step(a)
+            obs = stacker.push(frame) if stacker else frame
+            ep_ret += r
+        returns.append(ep_ret)
+    return float(np.mean(returns))
+
+
+def train_recurrent(cfg: Config, metrics: Metrics | None = None,
+                    log_every: int = 1_000) -> dict:
+    """R2D2 loop: recurrent actor → SequenceBuilder → SequenceReplay →
+    SequenceLearner. Sequence counts derive from transition-denominated
+    config fields (capacity/learn_start ÷ seq_len)."""
+    from distributed_deep_q_tpu.parallel.sequence_learner import SequenceSolver
+    from distributed_deep_q_tpu.replay.sequence import (
+        SequenceBuilder, SequenceReplay)
+
+    metrics = metrics or Metrics()
+    env = make_env(cfg.env, seed=cfg.train.seed)
+    cfg.net.num_actions = env.num_actions
+    obs_dim = int(np.prod(env.obs_shape))
+    solver = SequenceSolver(cfg, obs_dim=obs_dim)
+    rng = np.random.default_rng(cfg.train.seed)
+
+    pixel = env.obs_dtype == np.uint8
+    stacker = FrameStacker(env.obs_shape, cfg.env.stack) if pixel else None
+    obs_shape = (tuple(env.obs_shape) + (cfg.env.stack,)) if pixel \
+        else tuple(env.obs_shape)
+    obs_dtype = np.uint8 if pixel else np.float32
+
+    seq_len = cfg.replay.sequence_length
+    replay = SequenceReplay(
+        max(cfg.replay.capacity // seq_len, 64), seq_len, obs_shape,
+        obs_dtype, cfg.net.lstm_size, prioritized=cfg.replay.prioritized,
+        alpha=cfg.replay.priority_alpha, beta0=cfg.replay.priority_beta0,
+        beta_steps=cfg.replay.priority_beta_steps,
+        eps=cfg.replay.priority_eps, seed=cfg.train.seed)
+    builder = SequenceBuilder(seq_len, cfg.replay.burn_in, obs_shape,
+                              obs_dtype, cfg.net.lstm_size, cfg.train.gamma)
+    learn_start_seqs = max(cfg.replay.learn_start // seq_len, 2)
+
+    frame = env.reset()
+    obs = stacker.reset(frame) if pixel else frame
+    carry = solver.initial_state(1)
+    ep_ret, ep_returns = 0.0, MovingAverage(100)
+    summary: dict = {}
+    pending = None
+    gsteps = 0
+    ckpt = maybe_checkpointer(cfg.train)
+    if ckpt and cfg.train.resume and ckpt.latest_step() is not None:
+        solver.state, _ = ckpt.restore(solver.state)
+        gsteps = solver.step
+
+    for t in range(1, cfg.train.total_steps + 1):
+        eps = epsilon_at(t, cfg.actors)
+        carry_before = carry
+        a, carry = solver.act(np.asarray(obs), carry, eps, rng)
+        next_frame, r, done, over = env.step(a)
+        next_obs = stacker.push(next_frame) if pixel else next_frame
+        ep_ret += r
+        for seq in builder.on_step(obs, a, r, done,
+                                   (carry_before[0][0], carry_before[1][0]),
+                                   next_obs):
+            replay.add_sequence(seq)
+        obs = next_obs
+        metrics.count("env_steps")
+
+        if over:
+            if not done:
+                # time-limit truncation: emit the pending window with its
+                # bootstrap intact instead of discarding the episode tail
+                for seq in builder.flush_truncated(next_obs):
+                    replay.add_sequence(seq)
+            ep_returns.add(ep_ret)
+            ep_ret = 0.0
+            builder.reset()
+            frame = env.reset()
+            obs = stacker.reset(frame) if pixel else frame
+            carry = solver.initial_state(1)
+
+        if (replay.ready(learn_start_seqs)
+                and t % cfg.train.train_every == 0):
+            batch = replay.sample(cfg.replay.batch_size)
+            sampled_at = batch.pop("_sampled_at")
+            m = solver.train_step(batch)
+            gsteps += 1
+            if replay.prioritized:
+                if pending is not None:
+                    replay.update_priorities(pending[0],
+                                             np.asarray(pending[1]),
+                                             sampled_at=pending[2])
+                pending = (m["index"], m["td_abs"], sampled_at)
+            metrics.count("grad_steps")
+            if ckpt and gsteps % cfg.train.checkpoint_every == 0:
+                ckpt.save(solver.state, extra={"env_steps": t})
+            if gsteps % log_every == 0:
+                summary = {
+                    "loss": float(m["loss"]), "q_mean": float(m["q_mean"]),
+                    "return_avg100": ep_returns.value, "epsilon": eps,
+                    "grad_steps_per_s": metrics.rate("grad_steps"),
+                    "env_steps_per_s": metrics.rate("env_steps"),
+                }
+                metrics.log(gsteps, **summary)
+
+    if ckpt:
+        ckpt.save(solver.state, extra={"env_steps": cfg.train.total_steps},
+                  wait=True)
+    summary["final_return_avg100"] = ep_returns.value
+    summary["eval_return"] = evaluate_recurrent(solver, cfg)
     summary["solver"] = solver
     return summary

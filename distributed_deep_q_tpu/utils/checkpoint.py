@@ -1,0 +1,84 @@
+"""Checkpoint / resume via Orbax (SURVEY.md §5.4).
+
+The reference snapshots Caffe solver state (``.caffemodel``/``.solverstate``
+iter-N files) plus a PS θ dump [R]; here one Orbax checkpoint carries the
+complete learner state — (params, target_params, opt_state, step) — so
+resume restores training exactly (optimizer moments and the θ⁻ refresh
+phase included). The replay buffer is deliberately NOT persisted by default,
+matching reference behavior (warm-refill on restart).
+
+Layout: ``<dir>/<step>/`` managed by ``orbax.checkpoint.CheckpointManager``
+with retention of the most recent ``keep`` snapshots.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _manager(directory: str, keep: int = 3):
+    import orbax.checkpoint as ocp
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=keep, create=True),
+    )
+
+
+class Checkpointer:
+    """Save/restore the learner ``TrainState`` (feed-forward or sequence)."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self._mgr = _manager(directory, keep)
+
+    def save(self, state, extra: dict[str, Any] | None = None,
+             wait: bool = False) -> int:
+        """Asynchronously snapshot ``state`` at its current step; ``extra``
+        carries small host-side bookkeeping (e.g. env-step counters)."""
+        import orbax.checkpoint as ocp
+        step = int(state.step)
+        self._mgr.save(step, args=ocp.args.Composite(
+            state=ocp.args.StandardSave(state),
+            extra=ocp.args.JsonSave(
+                {k: float(v) for k, v in (extra or {}).items()}),
+        ))
+        if wait:
+            self._mgr.wait_until_finished()
+        return step
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, state_template):
+        """Restore the newest snapshot onto ``state_template``'s structure
+        (shapes/dtypes/shardings from the template, values from disk).
+        Returns (state, extra dict). Raises if no checkpoint exists."""
+        import orbax.checkpoint as ocp
+        step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint under {self.directory!r}")
+        restored = self._mgr.restore(step, args=ocp.args.Composite(
+            state=ocp.args.StandardRestore(state_template),
+            extra=ocp.args.JsonRestore(),
+        ))
+        return restored["state"], dict(restored["extra"] or {})
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def maybe_checkpointer(cfg) -> Checkpointer | None:
+    """Build from ``TrainConfig`` (checkpoint_dir/checkpoint_every)."""
+    if cfg.checkpoint_dir and cfg.checkpoint_every > 0:
+        return Checkpointer(cfg.checkpoint_dir)
+    return None
