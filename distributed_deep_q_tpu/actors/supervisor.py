@@ -60,6 +60,9 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     qnet = QNet(cfg.net, seed=cfg.train.seed,
                 obs_dim=int(np.prod(env.obs_shape)))
     client = ReplayFeedClient(host, port, actor_id=actor_id)
+    # announce a fresh writer on this stream id: the server seals the
+    # previous writer's slot so no sampled window straddles a restart seam
+    client.call("reset_stream")
     rng = np.random.default_rng(cfg.train.seed + 7777 * (actor_id + 1))
     eps = actor_epsilon(actor_id, cfg.actors.num_actors,
                         cfg.actors.eps_base, cfg.actors.eps_alpha)
@@ -252,8 +255,11 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     topology's unit of progress is learner steps, matching the north-star
     grad-steps/sec metric).
     """
+    import dataclasses
+
     from distributed_deep_q_tpu.actors.game import make_env
     from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
+    from distributed_deep_q_tpu.replay.multistream import MultiStreamFrameReplay
     from distributed_deep_q_tpu.replay.prioritized import maybe_prioritize
     from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
     from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedServer
@@ -267,18 +273,34 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     pixel = probe.obs_dtype == np.uint8
     del probe
 
+    # β anneal is denominated in sample() calls; this topology samples once
+    # per grad step (presets precompute it for the single-process cadence of
+    # one sample per train_every env steps)
+    replay_cfg = dataclasses.replace(
+        cfg.replay, priority_beta_steps=cfg.train.total_steps)
+
     solver = Solver(cfg, obs_dim=int(np.prod(obs_shape)))
-    if pixel:
+    if pixel and cfg.replay.device_resident:
         replay = DeviceFrameReplay(
-            cfg.replay, solver.mesh, obs_shape, cfg.env.stack,
+            replay_cfg, solver.mesh, obs_shape, cfg.env.stack,
             cfg.train.gamma, seed=cfg.train.seed,
             write_chunk=cfg.replay.write_chunk,
             num_streams=cfg.actors.num_actors)
+    elif pixel:
+        if cfg.replay.prioritized:
+            raise ValueError(
+                "prioritized replay in the distributed pixel topology "
+                "requires replay.device_resident=True (the host "
+                "MultiStreamFrameReplay fallback is uniform-only)")
+        replay = MultiStreamFrameReplay(
+            cfg.replay.capacity, obs_shape, cfg.env.stack, cfg.replay.n_step,
+            cfg.train.gamma, num_streams=cfg.actors.num_actors,
+            seed=cfg.train.seed)
     else:
         replay = maybe_prioritize(
             ReplayMemory(cfg.replay.capacity, obs_shape, np.float32,
                          seed=cfg.train.seed),
-            cfg.replay, seed=cfg.train.seed)
+            replay_cfg, seed=cfg.train.seed)
 
     server = ReplayFeedServer(replay, host=cfg.actors.host, port=0)
     server.publish_params(solver.get_weights())
@@ -290,15 +312,33 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
 
     pending = None
     summary: dict = {}
+    from distributed_deep_q_tpu.profiling import (
+        StepTimer, TraceWindow, start_profiler_server)
+    timer = StepTimer()
+    trace = TraceWindow(cfg.train.profile_dir, cfg.train.profile_start_step,
+                        cfg.train.profile_num_steps)
+    if cfg.train.profile_port:
+        start_profiler_server(cfg.train.profile_port)
     from distributed_deep_q_tpu.utils.checkpoint import maybe_checkpointer
     ckpt = maybe_checkpointer(cfg.train)
     if ckpt and cfg.train.resume and ckpt.latest_step() is not None:
         solver.state, _ = ckpt.restore(solver.state)
         server.publish_params(solver.get_weights())
+    stager = None
     try:
         # wait for warm-up fill (actors are streaming meanwhile)
         while not replay.ready(cfg.replay.learn_start):
             time.sleep(0.05)
+        if not isinstance(replay, DeviceFrameReplay):
+            # host-batch path: double-buffered sample → device_put pipeline
+            # (SURVEY §7.3 item 1); shares the server's replay lock so the
+            # background sampler serializes with RPC writers and with PER
+            # priority write-back below
+            from distributed_deep_q_tpu.replay.staging import DeviceStager
+            stager = DeviceStager(
+                lambda: replay.sample(cfg.replay.batch_size),
+                sharding=solver.learner._batch_sharding, depth=2,
+                lock=server.replay_lock)
         for gstep in range(1, cfg.train.total_steps + 1):
             if isinstance(replay, DeviceFrameReplay):
                 # sample AND dispatch under the lock: a concurrent actor
@@ -306,15 +346,20 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                 # enqueued before the ring handle can be invalidated
                 # (dispatch is µs; device execution stays async)
                 with server.replay_lock:
-                    batch = replay.sample(cfg.replay.batch_size)
+                    with timer.phase("sample"):
+                        batch = replay.sample(cfg.replay.batch_size)
                     sampled_at = batch.pop("_sampled_at")
-                    m = solver.train_step_from_ring(replay.ring, batch)
+                    with timer.phase("dispatch"):
+                        m = solver.train_step_from_ring(replay.ring, batch)
             else:
-                with server.replay_lock:
-                    batch = replay.sample(cfg.replay.batch_size)
-                    sampled_at = batch.pop("_sampled_at", replay.steps_added)
-                m = solver.train_step(batch)
+                with timer.phase("sample"):  # wait on the staging pipeline
+                    batch = stager.get()
+                sampled_at = batch.pop("_sampled_at", replay.steps_added)
+                with timer.phase("dispatch"):
+                    m = solver.train_step(batch)
             metrics.count("grad_steps")
+            timer.step_done()
+            trace.on_step(gstep)
 
             if replay.prioritized:
                 if pending is not None:
@@ -331,6 +376,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                 ckpt.save(solver.state, extra={"env_steps": server.env_steps})
 
             if gstep % log_every == 0:
+                timer.measure_device(m["loss"])
                 summary = {
                     "loss": float(m["loss"]),
                     "q_mean": float(m["q_mean"]),
@@ -340,8 +386,11 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                     "grad_steps_per_s": metrics.rate("grad_steps"),
                     "actor_restarts": sup.restarts,
                 }
-                metrics.log(gstep, **summary)
+                metrics.log(gstep, **summary, **timer.summary())
     finally:
+        trace.close()
+        if stager is not None:
+            stager.close()
         sup.stop()
         server.close()
 

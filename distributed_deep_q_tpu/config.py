@@ -65,8 +65,12 @@ class ReplayConfig:
 class TrainConfig:
     lr: float = 1e-4
     optimizer: str = "adam"  # adam | rmsprop (reference PS used RMSProp/AdaGrad [P])
+    adam_eps: float = 1.5e-4  # DQN-Atari convention; 1e-8 for classic control
     gamma: float = 0.99
     target_update_period: int = 500  # "every C pulls: θ⁻ ← θ" (SURVEY §3.1 [M])
+    # Polyak soft target updates: θ⁻ ← τθ + (1−τ)θ⁻ every step when τ > 0
+    # (overrides the hard period copy; the stable choice for small nets)
+    target_tau: float = 0.0
     double_dqn: bool = False
     huber_delta: float = 1.0
     # R2D2 sequence path: invertible value rescaling h(x) on targets, and
@@ -75,16 +79,29 @@ class TrainConfig:
     priority_eta: float = 0.9
     grad_clip_norm: float = 10.0
     total_steps: int = 50_000
-    # env steps per gradient step when running single-process
+    # env steps between learn phases when running single-process, and grad
+    # steps per learn phase — the reference worker's "actor phase: k steps /
+    # learn phase: j minibatches" cadence (SURVEY §3.1 [M])
     train_every: int = 4
+    grad_steps_per_train: int = 1
     eval_every: int = 0  # 0 = no periodic eval
     eval_episodes: int = 5
+    # with periodic eval on: keep the best-eval params and restore them at
+    # the end of training if the final params score worse (EvalCallback-
+    # style model selection; DQN end-of-run policies oscillate)
+    keep_best_eval: bool = False
     seed: int = 0
     # use the fused Pallas TD-loss kernel on TPU
     use_pallas_loss: bool = False
     checkpoint_dir: str = ""
     checkpoint_every: int = 0  # grad steps between Orbax snapshots
     resume: bool = False       # restore newest snapshot before training
+    # profiling (SURVEY §5.1): jax.profiler trace of a step window, and an
+    # optional live profiler server port (0 = off)
+    profile_dir: str = ""
+    profile_start_step: int = 100
+    profile_num_steps: int = 20
+    profile_port: int = 0
 
 
 @dataclass
@@ -155,16 +172,30 @@ class Config:
 
 
 def cartpole_config() -> Config:
-    """Config 1: CartPole-v1, 2-layer MLP Q-net, single worker, uniform replay."""
+    """Config 1: CartPole-v1, MLP Q-net, single worker, uniform replay.
+
+    Recipe selected empirically (scripts/diag_cartpole.py sweeps): Double
+    DQN + dueling + 3-step returns + Polyak targets (τ=0.005) converges
+    monotonically to 500/500 within 30k steps. Atari-style settings (hard
+    target copies, 1-step) plateau at ~120 from max-bias overestimation —
+    not a numerics bug (scripts/diag_mdp.py recovers analytic Q* exactly,
+    and a faithful torch replica of the published community recipe plateaus
+    identically in this environment). ``keep_best_eval`` guards the tail
+    against late policy oscillation; eval is greedy.
+    """
     c = Config()
-    c.net = NetConfig(kind="mlp", num_actions=2, hidden=(64, 64))
-    c.replay = ReplayConfig(capacity=50_000, batch_size=64, learn_start=1_000)
+    c.net = NetConfig(kind="mlp", num_actions=2, hidden=(128, 128),
+                      dueling=True)
+    c.replay = ReplayConfig(capacity=100_000, batch_size=128,
+                            learn_start=1_000, n_step=3)
     c.train = TrainConfig(
-        lr=1e-3, gamma=0.99, target_update_period=200, total_steps=30_000,
-        train_every=1, grad_clip_norm=10.0,
+        lr=5e-4, adam_eps=1e-8, gamma=0.99, target_tau=0.005,
+        double_dqn=True, total_steps=30_000, train_every=1,
+        grad_clip_norm=10.0, eval_every=2_500, keep_best_eval=True,
     )
     c.env = EnvConfig(id="CartPole-v1", kind="gym", stack=1, reward_clip=0.0)
-    c.actors = ActorConfig(num_actors=1, eps_decay_steps=5_000, eps_end=0.02)
+    c.actors = ActorConfig(num_actors=1, eps_decay_steps=8_000, eps_end=0.04,
+                           eval_eps=0.0)
     return c
 
 

@@ -23,8 +23,16 @@ class Metrics:
             try:
                 from torch.utils.tensorboard import SummaryWriter
                 self._tb = SummaryWriter(tensorboard_dir)
-            except Exception:
-                self._tb = None
+            except Exception as e:
+                # JSONL is the primary sink; TB mirroring is optional
+                # (torch absent, unwritable dir, ...) — warn with the cause
+                # instead of silently dropping the request or crashing the
+                # run over a mirror sink
+                import warnings
+                warnings.warn(
+                    f"tensorboard_dir requested but the TensorBoard writer "
+                    f"is unavailable ({type(e).__name__}: {e}); metrics go "
+                    f"to JSONL only", RuntimeWarning, stacklevel=2)
         self._t0 = time.monotonic()
         self._counters: dict[str, int] = {}
         self._marks: dict[str, tuple[float, int]] = {}

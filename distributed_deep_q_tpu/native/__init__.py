@@ -1,0 +1,91 @@
+"""Native (C++) replay core — build + ctypes loader.
+
+The reference's native layer is external (Caffe C++/CUDA, ALE; SURVEY.md
+§2.1); its own replay loops are Python. Here the PER sum-tree descent — the
+one host-side pointer-chasing hot loop (SURVEY §7.3 item 2) — has a C++
+implementation compiled on first use with the baked-in g++ toolchain
+(no pybind11 in the image, so the ABI is plain C via ctypes).
+
+``load()`` returns the ctypes lib or None (missing compiler, failed build);
+callers fall back to the numpy implementation, which remains the semantic
+reference. The build is cached next to the source and rebuilt only when
+``replay_core.cpp`` is newer than the cached ``.so``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "replay_core.cpp")
+_SO = os.path.join(_HERE, "_replay_core.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+_c_double_p = ctypes.POINTER(ctypes.c_double)
+_c_int64_p = ctypes.POINTER(ctypes.c_int64)
+
+
+def _build() -> bool:
+    """Compile to a process-unique temp path, then rename into place —
+    atomic on POSIX, so concurrent builders (supervisor-spawned actor
+    processes all importing replay) can never leave a half-written .so."""
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """Build (if needed) and load the native core; None on any failure."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        stale = (not os.path.exists(_SO)
+                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        if stale and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # cached artifact unloadable (foreign arch, corrupt file):
+            # rebuild once before giving up
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError:
+                return None
+        lib.st_set.argtypes = [_c_double_p, ctypes.c_int64, _c_int64_p,
+                               _c_double_p, ctypes.c_int64]
+        lib.st_set.restype = None
+        lib.st_sample_stratified.argtypes = [
+            _c_double_p, ctypes.c_int64, _c_double_p, _c_int64_p,
+            ctypes.c_int64]
+        lib.st_sample_stratified.restype = None
+        _lib = lib
+        return _lib
+
+
+def as_double_p(a) -> _c_double_p:
+    return a.ctypes.data_as(_c_double_p)
+
+
+def as_int64_p(a) -> _c_int64_p:
+    return a.ctypes.data_as(_c_int64_p)

@@ -47,7 +47,7 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     """Optimizer chain. The reference PS applied RMSProp/AdaGrad-style
     updates (SURVEY §3.4 [P]); we default to Adam with the same switch."""
     if cfg.optimizer == "adam":
-        opt = optax.adam(cfg.lr, eps=1.5e-4)
+        opt = optax.adam(cfg.lr, eps=cfg.adam_eps)
     elif cfg.optimizer == "rmsprop":
         opt = optax.rmsprop(cfg.lr, decay=0.95, eps=1e-2, centered=True)
     else:
@@ -55,6 +55,23 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     if cfg.grad_clip_norm > 0:
         return optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), opt)
     return opt
+
+
+def refresh_target(cfg: TrainConfig, params: Any, target_params: Any,
+                   step: jax.Array) -> Any:
+    """θ⁻ update, shared by both learners: Polyak θ⁻ ← τθ + (1−τ)θ⁻ every
+    step when ``target_tau`` > 0, else the hard copy every C steps
+    ("every C pulls: θ⁻ ← θ", SURVEY §3.1 [M]) via lax.cond so the copy
+    stays off the hot path on non-refresh steps."""
+    if cfg.target_tau > 0:
+        tau = cfg.target_tau
+        return jax.tree.map(lambda p, t: tau * p + (1.0 - tau) * t,
+                            params, target_params)
+    return lax.cond(
+        step % cfg.target_update_period == 0,
+        lambda: params,
+        lambda: target_params,
+    )
 
 
 class Learner:
@@ -110,9 +127,16 @@ class Learner:
             targets = bellman_targets(
                 batch["reward"], batch["discount"], q_next_t,
                 q_next_o, cfg.double_dqn)
-            loss, td_abs = dqn_loss(
-                q, batch["action"], targets, batch["weight"],
-                cfg.huber_delta)
+            if cfg.use_pallas_loss:
+                from distributed_deep_q_tpu.ops.pallas_kernels import (
+                    fused_dqn_loss)
+                loss, td_abs = fused_dqn_loss(
+                    q, batch["action"], lax.stop_gradient(targets),
+                    batch["weight"], cfg.huber_delta)
+            else:
+                loss, td_abs = dqn_loss(
+                    q, batch["action"], targets, batch["weight"],
+                    cfg.huber_delta)
             return loss, (td_abs, q)
 
         (loss, (td_abs, q)), grads = jax.value_and_grad(
@@ -129,13 +153,7 @@ class Learner:
         params = optax.apply_updates(state.params, updates)
         step = state.step + 1
 
-        # θ⁻ ← θ every C steps (SURVEY §3.1 [M]); lax.cond keeps the
-        # copy off the hot path on non-refresh steps.
-        target_params = lax.cond(
-            step % cfg.target_update_period == 0,
-            lambda: params,
-            lambda: state.target_params,
-        )
+        target_params = refresh_target(cfg, params, state.target_params, step)
         new_state = TrainState(params, target_params, opt_state, step)
         metrics = {
             "loss": loss,

@@ -38,7 +38,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distributed_deep_q_tpu.config import ReplayConfig, TrainConfig
 from distributed_deep_q_tpu.ops.losses import (
     sequence_bellman_targets, sequence_dqn_loss)
-from distributed_deep_q_tpu.parallel.learner import TrainState, make_optimizer
+from distributed_deep_q_tpu.parallel.learner import (
+    TrainState, make_optimizer, refresh_target)
 from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
 
 
@@ -114,11 +115,8 @@ class SequenceLearner:
                                             state.params)
             params = optax.apply_updates(state.params, updates)
             step = state.step + 1
-            target_params = lax.cond(
-                step % cfg.target_update_period == 0,
-                lambda: params,
-                lambda: state.target_params,
-            )
+            target_params = refresh_target(cfg, params, state.target_params,
+                                           step)
             new_state = TrainState(params, target_params, opt_state, step)
             metrics = {
                 "loss": loss,

@@ -1,0 +1,135 @@
+"""Tracing / profiling subsystem (SURVEY.md §5.1).
+
+The reference has nothing beyond Caffe layer timing and prints [R]; the
+rebuild gets two first-class tools:
+
+- ``StepTimer`` — cheap host-side wall-time breakdown of the train loop's
+  phases (``sample`` / ``host_compose`` / ``dispatch`` / ``device``),
+  accumulated per step and emitted through ``Metrics`` as
+  ``time_<phase>_ms`` scalars. Dispatch is what the host pays to enqueue
+  the XLA program (µs when the pipeline is healthy); ``device`` is measured
+  by blocking on the step's outputs, so it's recorded only on logging
+  steps — blocking every step would serialize the pipeline the timer
+  exists to protect.
+
+- ``TraceWindow`` — a ``jax.profiler`` trace capture over a step window
+  (e.g. steps 100–120), plus ``start_profiler_server`` for live
+  TensorBoard-connected profiling. Enabled with
+  ``TrainConfig.profile_dir`` / ``profile_port``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Iterator
+
+import jax
+
+
+class StepTimer:
+    """Accumulates per-phase wall time across train-loop steps.
+
+    Usage::
+
+        with timer.phase("sample"):
+            batch = replay.sample(n)
+        with timer.phase("dispatch"):
+            m = solver.train_step(batch)
+        ...
+        timer.step_done()
+        if logging:
+            metrics.log(step, **timer.summary())
+
+    ``summary()`` returns mean milliseconds per phase since the last call
+    (keys ``time_<phase>_ms``) plus ``time_step_ms`` (mean wall time per
+    step, measured step_done→step_done, covering phases AND everything
+    between them).
+    """
+
+    def __init__(self) -> None:
+        self._acc: dict[str, float] = defaultdict(float)
+        self._steps = 0
+        self._last_step_t: float | None = None
+        self._step_total = 0.0
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._acc[name] += time.perf_counter() - t0
+
+    def measure_device(self, outputs) -> None:
+        """Block until ``outputs`` (the step's device results) are done and
+        attribute the wait to the ``device`` phase. Call on logging steps
+        only — this synchronizes the pipeline."""
+        with self.phase("device"):
+            jax.block_until_ready(outputs)
+
+    def step_done(self) -> None:
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_total += now - self._last_step_t
+        self._last_step_t = now
+        self._steps += 1
+
+    def summary(self, reset: bool = True) -> dict[str, float]:
+        n = max(self._steps, 1)
+        out = {f"time_{k}_ms": 1e3 * v / n for k, v in self._acc.items()}
+        # device is measured once per summary window, not per step
+        if "time_device_ms" in out:
+            out["time_device_ms"] = 1e3 * self._acc["device"]
+        if self._steps > 1:
+            out["time_step_ms"] = 1e3 * self._step_total / (self._steps - 1)
+        if reset:
+            self._acc.clear()
+            self._steps = 0
+            self._step_total = 0.0
+            # drop the carried timestamp too: each window then averages
+            # exactly (steps−1) intra-window intervals over (steps−1),
+            # keeping windows mutually consistent
+            self._last_step_t = None
+        return out
+
+
+class TraceWindow:
+    """Capture a ``jax.profiler`` trace over a contiguous step window.
+
+    ``on_step(step)`` is called once per train-loop step; the trace starts
+    when ``step == start_step`` and stops after ``num_steps`` steps (or at
+    ``close()``). Output is a TensorBoard-loadable trace directory.
+    """
+
+    def __init__(self, logdir: str, start_step: int = 100,
+                 num_steps: int = 20):
+        self.logdir = logdir
+        self.start_step = int(start_step)
+        self.num_steps = int(num_steps)
+        self._active = False
+        self._done = False
+
+    def on_step(self, step: int) -> None:
+        if self._done or not self.logdir:
+            return
+        if not self._active and step >= self.start_step:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+            self._stop_at = step + self.num_steps
+        elif self._active and step >= self._stop_at:
+            self.stop()
+
+    def stop(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    close = stop
+
+
+def start_profiler_server(port: int) -> None:
+    """Live profiling endpoint (TensorBoard "capture profile" target)."""
+    jax.profiler.start_server(int(port))

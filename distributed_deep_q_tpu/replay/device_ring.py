@@ -46,7 +46,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_deep_q_tpu.config import ReplayConfig
 from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
 from distributed_deep_q_tpu.replay.prioritized import (
-    SumTree, beta_at, filter_stale, sample_valid_from_tree)
+    SumTree, allocate_proportional, beta_at, filter_stale,
+    sample_valid_from_tree)
 from distributed_deep_q_tpu.replay.replay_memory import FrameStackReplay
 
 
@@ -93,6 +94,10 @@ class DeviceFrameReplay:
         assert self.slot_cap > 0 and cfg.batch_size % d == 0, (
             f"capacity {cfg.capacity} must split over {g} stream slots and "
             f"batch {cfg.batch_size} over {d} shards")
+        # one flush chunk must never wrap a sub-ring: a wrap would scatter
+        # duplicate in-shard offsets in one .at[].set (unspecified winner →
+        # stale pixels under fresh metadata), so clamp the chunk size
+        write_chunk = min(int(write_chunk), self.slot_cap)
         self.cap_local = self.slot_cap * self.subs_per_shard
         self.capacity = self.cap_local * d
         self.stack = int(stack)
@@ -108,7 +113,8 @@ class DeviceFrameReplay:
                              gamma, seed=seed + i, store_frames=False)
             for i in range(g)]
         # per-slot priority trees with SHARED max-priority/β bookkeeping
-        self.trees = ([SumTree(self.slot_cap) for _ in range(g)]
+        self.trees = ([SumTree(self.slot_cap, use_native=cfg.use_native)
+                       for _ in range(g)]
                       if self.prioritized else None)
         self.max_priority = 1.0
         self._samples = 0
@@ -234,6 +240,18 @@ class DeviceFrameReplay:
             self.flush()
         return out
 
+    def reset_stream(self, stream: int) -> None:
+        """Seal the stream's current slot at a writer identity change
+        (actor restart reusing the stream id — SURVEY §5.3 recovery path):
+        the slot's last written row gets a truncation boundary so no sampled
+        stack or n-step window can straddle the dead actor's half-episode
+        and the replacement's first episode."""
+        if not (0 <= stream < self.num_streams):
+            return
+        cycle = self._slot_cycle[stream]
+        slot = cycle[self._stream_pos[stream] % len(cycle)]
+        self.slots[slot].seal_stream()
+
     def flush(self) -> None:
         """Push all staged frames to HBM in fixed-shape chunks.
 
@@ -258,16 +276,7 @@ class DeviceFrameReplay:
 
     def _allocate(self, quota: int, masses: list[float]) -> list[int]:
         """Split ``quota`` draws across slots ∝ mass (largest remainder)."""
-        total = sum(masses)
-        if total <= 0:
-            return [0] * len(masses)
-        exact = [quota * m / total for m in masses]
-        counts = [int(e) for e in exact]
-        rem = quota - sum(counts)
-        for i in sorted(range(len(exact)),
-                        key=lambda i: exact[i] - counts[i], reverse=True)[:rem]:
-            counts[i] += 1
-        return counts
+        return allocate_proportional(quota, masses)
 
     def sample(self, batch_size: int) -> dict[str, np.ndarray]:
         """Index batch (no pixels): per-shard draws concatenated in mesh
@@ -310,16 +319,28 @@ class DeviceFrameReplay:
         batch = {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
 
         if self.prioritized:
-            # global IS weights: P(i) = p_i / Σ_all mass, N = global fill
-            total_mass = sum(t.total for t in self.trees)
+            # IS weights for the REALIZED stratified distribution: each
+            # shard contributes exactly batch/D draws (proportional within
+            # the shard), so P(i) = p_i / (D · mass_shard(i)) — using the
+            # global mass would bias weights whenever shard masses differ.
+            # Only SAMPLEABLE slots count: the allocation above zeroes
+            # unsampleable ones, so their mass is not part of the realized
+            # distribution either.
+            shard_mass = np.zeros(d)
+            for g in range(self.num_slots):
+                if self._sampleable(g):
+                    shard_mass[g % d] += self.trees[g].total
+            owner_shard = batch.pop("_slot") % d
             n = len(self)
-            pr = np.maximum(batch.pop("_p") / max(total_mass, 1e-12), 1e-12)
+            pr = np.maximum(
+                batch.pop("_p")
+                / np.maximum(d * shard_mass[owner_shard], 1e-12), 1e-12)
             w = (n * pr) ** (-self.beta)
             batch["weight"] = (w / w.max()).astype(np.float32)
         else:
             batch.pop("_p")
+            batch.pop("_slot")
             batch["weight"] = np.ones(batch_size, np.float32)
-        batch.pop("_slot")
         batch["valid"] = batch["valid"].astype(np.uint8)
         batch["nvalid"] = batch["nvalid"].astype(np.uint8)
         batch["index"] = batch["index"].astype(np.int32)

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from distributed_deep_q_tpu import native as _native
+
 
 class SumTree:
     """Flat-array complete binary tree holding priorities in its leaves.
@@ -33,13 +35,16 @@ class SumTree:
     total mass is at the root, index 1. All ops are batched numpy.
     """
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, use_native: bool = True):
         self.capacity = int(capacity)
         size = 1
         while size < capacity:
             size *= 2
         self.size = size
         self.tree = np.zeros(2 * size, np.float64)
+        # C++ descent/set loops (native/replay_core.cpp) when buildable;
+        # the numpy paths below remain the semantic reference
+        self._native = _native.load() if use_native else None
 
     @property
     def total(self) -> float:
@@ -51,6 +56,17 @@ class SumTree:
     def set(self, idx: np.ndarray, p: np.ndarray) -> None:
         """Set leaf priorities and repair all affected ancestors, level by
         level (duplicate indices resolve to the last write, like numpy)."""
+        if self._native is not None:
+            idx64 = np.ascontiguousarray(idx, np.int64)
+            p64 = np.ascontiguousarray(p, np.float64)
+            if idx64.size and (idx64.min() < 0 or idx64.max() >= self.size):
+                raise IndexError(  # keep numpy's fail-fast, not a heap write
+                    f"SumTree.set: index out of range [0, {self.size})")
+            self._native.st_set(
+                _native.as_double_p(self.tree), self.size,
+                _native.as_int64_p(idx64), _native.as_double_p(p64),
+                len(idx64))
+            return
         leaf = np.asarray(idx, np.int64) + self.size
         self.tree[leaf] = p
         parents = np.unique(leaf >> 1)
@@ -68,6 +84,14 @@ class SumTree:
         level per iteration — log₂(size) numpy steps, no Python recursion)."""
         total = self.tree[1]
         assert total > 0, "sample from empty SumTree"
+        if self._native is not None:
+            urand = np.ascontiguousarray(rng.random(batch_size))
+            out = np.empty(batch_size, np.int64)
+            self._native.st_sample_stratified(
+                _native.as_double_p(self.tree), self.size,
+                _native.as_double_p(urand), _native.as_int64_p(out),
+                batch_size)
+            return out
         targets = (np.arange(batch_size) + rng.random(batch_size)) \
             * (total / batch_size)
         idx = np.ones(batch_size, np.int64)
@@ -106,6 +130,22 @@ def filter_stale(idx: np.ndarray, vals: np.ndarray, steps_added: int,
     cursor_then = sampled_at % capacity
     fresh = ((idx - cursor_then) % capacity) >= written
     return idx[fresh], vals[fresh]
+
+
+def allocate_proportional(quota: int, masses: list[float]) -> list[int]:
+    """Split ``quota`` integer draws across bins ∝ mass (largest remainder).
+    Shared by the device ring's slot allocation and the host multi-stream
+    replay. All-zero mass → all-zero counts."""
+    total = sum(masses)
+    if total <= 0:
+        return [0] * len(masses)
+    exact = [quota * m / total for m in masses]
+    counts = [int(e) for e in exact]
+    rem = quota - sum(counts)
+    for i in sorted(range(len(exact)),
+                    key=lambda i: exact[i] - counts[i], reverse=True)[:rem]:
+        counts[i] += 1
+    return counts
 
 
 def sample_valid_from_tree(tree: SumTree, base, count: int,
@@ -149,13 +189,14 @@ class PrioritizedReplay:
         beta_steps: int = 1_000_000,
         eps: float = 1e-6,
         seed: int = 0,
+        use_native: bool = True,
     ):
         self.base = base
         self.alpha = float(alpha)
         self.beta0 = float(beta0)
         self.beta_steps = int(beta_steps)
         self.eps = float(eps)
-        self.tree = SumTree(base.capacity)
+        self.tree = SumTree(base.capacity, use_native=use_native)
         self.max_priority = 1.0
         self._samples = 0
         self._rng = np.random.default_rng(seed)
@@ -239,4 +280,5 @@ def maybe_prioritize(base, cfg, seed: int = 0):
         return base
     return PrioritizedReplay(
         base, alpha=cfg.priority_alpha, beta0=cfg.priority_beta0,
-        beta_steps=cfg.priority_beta_steps, eps=cfg.priority_eps, seed=seed)
+        beta_steps=cfg.priority_beta_steps, eps=cfg.priority_eps, seed=seed,
+        use_native=cfg.use_native)

@@ -204,6 +204,19 @@ class FrameStackReplay:
         self._steps_added += n
         return idx
 
+    def seal_stream(self) -> None:
+        """Mark an episode boundary on the last written row.
+
+        Called when the writer stream changes identity mid-episode (actor
+        crash → respawn reusing the stream id): without the seal, sampled
+        stacks and n-step windows could straddle the dead actor's half
+        episode and the replacement's first episode. ``done`` stays False —
+        the truncation-only boundary excludes straddling windows from
+        sampling rather than faking a terminal.
+        """
+        if self._size:
+            self.boundary[(self._cursor - 1) % self.capacity] = True
+
     # -- sampling ----------------------------------------------------------
 
     def _invalid(self, idx: np.ndarray) -> np.ndarray:

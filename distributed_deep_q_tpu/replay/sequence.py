@@ -47,6 +47,7 @@ class SequenceReplay:
         beta_steps: int = 1_000_000,
         eps: float = 1e-6,
         seed: int = 0,
+        use_native: bool = True,
     ):
         self.capacity = int(capacity)
         self.seq_len = int(seq_len)
@@ -66,7 +67,8 @@ class SequenceReplay:
         self.prioritized = bool(prioritized)
         self.alpha, self.beta0 = float(alpha), float(beta0)
         self.beta_steps, self.eps = int(beta_steps), float(eps)
-        self.tree = SumTree(capacity) if prioritized else None
+        self.tree = (SumTree(capacity, use_native=use_native)
+                     if prioritized else None)
         self.max_priority = 1.0
         self._samples = 0
 

@@ -1,0 +1,110 @@
+"""Host→device staging — double-buffered batch prefetch (SURVEY.md §7.3
+item 1: "keeping a pmap'd learner fed from a Python replay buffer …
+double-buffering, avoiding device_put stalls is where the 50× target is won
+or lost").
+
+The reference ships minibatches across a Python↔Caffe process boundary every
+step (barista-style shmem + sockets, SURVEY §2 "IPC / shared-memory glue"
+[R]). The TPU equivalent of that glue is ``jax.device_put`` onto the mesh's
+batch sharding — and hiding its latency: a background thread keeps a small
+queue of batches already resident on device, so the learner's ``get()``
+returns a device batch that was transferred while the previous step was
+computing.
+
+Host-only bookkeeping keys (``index``, ``_sampled_at``) ride along
+untransferred so PER priority write-back still works. Depth 2 is true double
+buffering: one batch being consumed, one in flight.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable
+
+import jax
+
+HOST_KEYS = ("index", "_sampled_at")
+
+
+class DeviceStager:
+    """Background sampler → device transfer pipeline.
+
+    ``sample_fn()`` produces a host batch dict; batches appear on the
+    internal queue already ``device_put`` to ``sharding`` (host-only keys
+    kept as numpy). Call ``get()`` in the learner loop; ``close()`` joins
+    the thread. The queue is bounded (``depth``), so sampling backpressures
+    when the learner falls behind rather than buffering stale batches —
+    this bounds PER priority staleness to ``depth`` steps.
+    """
+
+    def __init__(self, sample_fn: Callable[[], dict[str, Any]],
+                 sharding=None, depth: int = 2,
+                 lock: threading.Lock | None = None):
+        """``lock`` serializes ``sample_fn`` against writers that mutate the
+        same replay from other threads (PER ``update_priorities``, RPC
+        ``add_batch``) — the SumTree is not internally synchronized, so PER
+        callers MUST pass the lock they use for priority write-back."""
+        self._sample_fn = sample_fn
+        self._sharding = sharding
+        self._lock = lock if lock is not None else threading.Lock()
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._err: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="replay-stager")
+        self._thread.start()
+
+    def _stage(self, batch: dict[str, Any]) -> dict[str, Any]:
+        host = {k: batch.pop(k) for k in HOST_KEYS if k in batch}
+        if self._sharding is not None:
+            dev = jax.device_put(batch, self._sharding)
+        else:
+            dev = jax.device_put(batch)
+        dev.update(host)
+        return dev
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    batch = self._sample_fn()
+                staged = self._stage(batch)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(staged, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # surfaced on the consumer's next get()
+            self._err = e
+
+    @property
+    def lock(self) -> threading.Lock:
+        """The sampler lock; hold it for any replay mutation (priority
+        write-back, adds) done outside this stager's thread."""
+        return self._lock
+
+    def get(self, timeout: float = 30.0) -> dict[str, Any]:
+        """Next device-resident batch (blocks until the pipeline has one)."""
+        deadline = timeout
+        while True:
+            if self._err is not None:
+                raise RuntimeError("staging thread failed") from self._err
+            try:
+                return self._q.get(timeout=min(deadline, 0.5))
+            except queue.Empty:
+                deadline -= 0.5
+                if deadline <= 0:
+                    raise TimeoutError(
+                        "DeviceStager.get(): no batch produced in time")
+
+    def close(self) -> None:
+        self._stop.set()
+        # drain so a blocked put() can observe the stop flag
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)

@@ -139,6 +139,15 @@ class ReplayFeedServer:
                     return {"version": self._params_version}  # no-op refresh
                 return dict(self._params)
 
+        if method == "reset_stream":
+            # a fresh actor process announcing itself on a (possibly reused)
+            # stream id: seal the stream's current slot so no sampled window
+            # straddles the previous writer's half-episode (SURVEY §5.3)
+            with self.replay_lock:
+                if hasattr(self.replay, "reset_stream") and actor_id >= 0:
+                    self.replay.reset_stream(actor_id)
+            return {"ok": True}
+
         if method == "heartbeat":
             return {"ok": True}
 

@@ -8,12 +8,15 @@ One process hosts actor + replay + learner; the distributed topology
 
 from __future__ import annotations
 
+import jax
 import numpy as np
 
 from distributed_deep_q_tpu.actors.game import (
     FrameStacker, NStepAccumulator, make_env)
 from distributed_deep_q_tpu.config import Config
 from distributed_deep_q_tpu.metrics import Metrics, MovingAverage
+from distributed_deep_q_tpu.profiling import (
+    StepTimer, TraceWindow, start_profiler_server)
 from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
 from distributed_deep_q_tpu.replay.prioritized import maybe_prioritize
 from distributed_deep_q_tpu.replay.replay_memory import FrameStackReplay, ReplayMemory
@@ -90,84 +93,114 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
     summary: dict = {}
     pending = None  # (index, td_abs, sampled_at) awaiting PER write-back
     gsteps = 0
+    best_eval, best_params = float("-inf"), None
+    timer = StepTimer()
+    trace = TraceWindow(cfg.train.profile_dir, cfg.train.profile_start_step,
+                        cfg.train.profile_num_steps)
+    if cfg.train.profile_port:
+        start_profiler_server(cfg.train.profile_port)
     ckpt = maybe_checkpointer(cfg.train)
     if ckpt and cfg.train.resume and ckpt.latest_step() is not None:
         solver.state, _ = ckpt.restore(solver.state)
         gsteps = solver.step
 
-    for t in range(1, cfg.train.total_steps + 1):
-        eps = epsilon_at(t, cfg.actors)
-        a = solver.act(obs, eps, rng)
-        next_frame, r, done, over = env.step(a)
-        ep_ret += r
+    try:
+        for t in range(1, cfg.train.total_steps + 1):
+            eps = epsilon_at(t, cfg.actors)
+            a = solver.act(obs, eps, rng)
+            next_frame, r, done, over = env.step(a)
+            ep_ret += r
 
-        if pixel_env:
-            # frame (pre-action), action, reward, done; boundary marks any
-            # episode end incl. truncation so stacks/windows never cross it
-            replay.add(frame, a, r, done, boundary=over)
-            frame = next_frame
-            obs = stacker.push(frame)
-        else:
-            for tr in nstep.push(obs, a, r, next_frame, done):
-                replay.add(*tr)
-            obs = next_frame
-        metrics.count("env_steps")
-
-        if over:
-            if not pixel_env and not done:
-                # time-limit truncation: flush the n-step tail with bootstrap
-                # instead of discarding the end-of-episode transitions
-                for tr in nstep.flush_truncated(next_frame):
-                    replay.add(*tr)
-            ep_returns.add(ep_ret)
-            ep_ret = 0.0
-            frame = env.reset()
             if pixel_env:
-                obs = stacker.reset(frame)
+                # frame (pre-action), action, reward, done; boundary marks any
+                # episode end incl. truncation so stacks/windows never cross it
+                replay.add(frame, a, r, done, boundary=over)
+                frame = next_frame
+                obs = stacker.push(frame)
             else:
-                obs = frame
-                nstep.reset()
+                for tr in nstep.push(obs, a, r, next_frame, done):
+                    replay.add(*tr)
+                obs = next_frame
+            metrics.count("env_steps")
 
-        if (replay.ready(cfg.replay.learn_start)
-                and t % cfg.train.train_every == 0):
-            batch = replay.sample(cfg.replay.batch_size)
-            sampled_at = batch.pop("_sampled_at", replay.steps_added)
-            if isinstance(replay, DeviceFrameReplay):
-                m = solver.train_step_from_ring(replay.ring, batch)
-            else:
-                m = solver.train_step(batch)
-            gsteps += 1
-            if replay.prioritized:
-                # one-step-delayed priority write-back: materializing |TD|
-                # for the *previous* step is free by now (its device work is
-                # done), so the fresh step is never host-blocked
-                if pending is not None:
-                    replay.update_priorities(pending[0],
-                                             np.asarray(pending[1]),
-                                             sampled_at=pending[2])
-                pending = (m["index"], m["td_abs"], sampled_at)
-            metrics.count("grad_steps")
-            if ckpt and gsteps % cfg.train.checkpoint_every == 0:
-                ckpt.save(solver.state, extra={"env_steps": t})
-            # host-side counter: reading solver.step would sync on the
-            # just-dispatched device step every iteration
-            if gsteps % log_every == 0:
-                summary = {
-                    "loss": float(m["loss"]), "q_mean": float(m["q_mean"]),
-                    "return_avg100": ep_returns.value, "epsilon": eps,
-                    "grad_steps_per_s": metrics.rate("grad_steps"),
-                    "env_steps_per_s": metrics.rate("env_steps"),
-                }
-                metrics.log(solver.step, **summary)
+            if over:
+                if not pixel_env and not done:
+                    # time-limit truncation: flush the n-step tail with bootstrap
+                    # instead of discarding the end-of-episode transitions
+                    for tr in nstep.flush_truncated(next_frame):
+                        replay.add(*tr)
+                ep_returns.add(ep_ret)
+                ep_ret = 0.0
+                frame = env.reset()
+                if pixel_env:
+                    obs = stacker.reset(frame)
+                else:
+                    obs = frame
+                    nstep.reset()
 
-        if (cfg.train.eval_every and t % cfg.train.eval_every == 0):
-            metrics.log(solver.step, eval_return=evaluate(solver, cfg))
+            if (replay.ready(cfg.replay.learn_start)
+                    and t % cfg.train.train_every == 0):
+                # learn phase: j minibatches per k env steps (SURVEY §3.1 [M])
+                for _ in range(cfg.train.grad_steps_per_train):
+                    with timer.phase("sample"):
+                        batch = replay.sample(cfg.replay.batch_size)
+                    sampled_at = batch.pop("_sampled_at", replay.steps_added)
+                    with timer.phase("dispatch"):
+                        if isinstance(replay, DeviceFrameReplay):
+                            m = solver.train_step_from_ring(replay.ring, batch)
+                        else:
+                            m = solver.train_step(batch)
+                    gsteps += 1
+                    timer.step_done()
+                    trace.on_step(gsteps)
+                    if replay.prioritized:
+                        # one-step-delayed priority write-back: materializing
+                        # |TD| for the *previous* step is free by now (its
+                        # device work is done), so the fresh step is never
+                        # host-blocked
+                        if pending is not None:
+                            replay.update_priorities(pending[0],
+                                                     np.asarray(pending[1]),
+                                                     sampled_at=pending[2])
+                        pending = (m["index"], m["td_abs"], sampled_at)
+                    metrics.count("grad_steps")
+                    if ckpt and gsteps % cfg.train.checkpoint_every == 0:
+                        ckpt.save(solver.state, extra={"env_steps": t})
+                    # host-side counter: reading solver.step would sync on the
+                    # just-dispatched device step every iteration
+                    if gsteps % log_every == 0:
+                        timer.measure_device(m["loss"])
+                        summary = {
+                            "loss": float(m["loss"]),
+                            "q_mean": float(m["q_mean"]),
+                            "return_avg100": ep_returns.value, "epsilon": eps,
+                            "grad_steps_per_s": metrics.rate("grad_steps"),
+                            "env_steps_per_s": metrics.rate("env_steps"),
+                        }
+                        metrics.log(solver.step, **summary, **timer.summary())
 
+            if (cfg.train.eval_every and t % cfg.train.eval_every == 0):
+                ret = evaluate(solver, cfg)
+                metrics.log(solver.step, eval_return=ret)
+                if cfg.train.keep_best_eval and ret > best_eval:
+                    best_eval = ret
+                    best_params = jax.device_get(solver.state.params)
+
+    finally:
+        trace.close()
+    summary["final_return_avg100"] = ep_returns.value
+    final_ret = evaluate(solver, cfg)
+    if best_params is not None and best_eval > final_ret:
+        # model selection: the best-eval snapshot beats the final params;
+        # restore BEFORE the final checkpoint so what's on disk is what
+        # eval_return reports
+        solver.state = solver.state.replace(params=jax.device_put(
+            best_params, solver.learner._replicated))
+        final_ret = evaluate(solver, cfg)
     if ckpt:
         ckpt.save(solver.state, extra={"env_steps": cfg.train.total_steps},
                   wait=True)
-    summary["final_return_avg100"] = ep_returns.value
-    summary["eval_return"] = evaluate(solver, cfg)
+    summary["eval_return"] = final_ret
     summary["solver"] = solver
     return summary
 

@@ -1,0 +1,111 @@
+"""Diagnostic-only: minimal torch DQN replicating the SB3-zoo CartPole-v1
+recipe as faithfully as possible, to establish whether that recipe solves in
+THIS container (gymnasium version, CPU) at all. Not part of the package.
+"""
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import gymnasium
+
+SEED = 0
+TOTAL = 50_000
+LR = 2.3e-3
+BATCH = 64
+BUF = 100_000
+LEARN_START = 1_000
+GAMMA = 0.99
+TRAIN_FREQ = 256
+GRAD_STEPS = 128
+TGT_INTERVAL = 10       # env steps, SB3 semantics
+EPS_FRACTION = 0.16
+EPS_FINAL = 0.04
+
+torch.manual_seed(SEED)
+rng = np.random.default_rng(SEED)
+env = gymnasium.make("CartPole-v1")
+
+
+def make_net():
+    return nn.Sequential(nn.Linear(4, 256), nn.ReLU(),
+                         nn.Linear(256, 256), nn.ReLU(), nn.Linear(256, 2))
+
+
+q, q_tgt = make_net(), make_net()
+q_tgt.load_state_dict(q.state_dict())
+opt = torch.optim.Adam(q.parameters(), lr=LR)
+
+obs_buf = np.zeros((BUF, 4), np.float32)
+nobs_buf = np.zeros((BUF, 4), np.float32)
+act_buf = np.zeros(BUF, np.int64)
+rew_buf = np.zeros(BUF, np.float32)
+done_buf = np.zeros(BUF, np.float32)
+cursor, size = 0, 0
+
+
+def add(o, a, r, no, d):
+    global cursor, size
+    obs_buf[cursor], act_buf[cursor], rew_buf[cursor] = o, a, r
+    nobs_buf[cursor], done_buf[cursor] = no, d
+    cursor = (cursor + 1) % BUF
+    size = min(size + 1, BUF)
+
+
+def train_burst():
+    for _ in range(GRAD_STEPS):
+        idx = rng.integers(0, size, BATCH)
+        o = torch.as_tensor(obs_buf[idx])
+        no = torch.as_tensor(nobs_buf[idx])
+        a = torch.as_tensor(act_buf[idx])
+        r = torch.as_tensor(rew_buf[idx])
+        d = torch.as_tensor(done_buf[idx])
+        with torch.no_grad():
+            tgt = r + (1 - d) * GAMMA * q_tgt(no).max(1).values
+        qsa = q(o).gather(1, a[:, None])[:, 0]
+        loss = F.smooth_l1_loss(qsa, tgt)
+        opt.zero_grad()
+        loss.backward()
+        nn.utils.clip_grad_norm_(q.parameters(), 10.0)
+        opt.step()
+
+
+def evaluate(episodes=5):
+    e = gymnasium.make("CartPole-v1")
+    rets = []
+    for ep in range(episodes):
+        o, _ = e.reset(seed=10_000 + ep)
+        ret, over = 0.0, False
+        while not over:
+            with torch.no_grad():
+                a = int(q(torch.as_tensor(o[None])).argmax())
+            o, r, term, trunc, _ = e.step(a)
+            ret += r
+            over = term or trunc
+        rets.append(ret)
+    return float(np.mean(rets))
+
+
+o, _ = env.reset(seed=SEED)
+ep = 0
+for t in range(1, TOTAL + 1):
+    frac = min(t / (EPS_FRACTION * TOTAL), 1.0)
+    eps = 1.0 + frac * (EPS_FINAL - 1.0)
+    if rng.random() < eps:
+        a = int(rng.integers(2))
+    else:
+        with torch.no_grad():
+            a = int(q(torch.as_tensor(o[None])).argmax())
+    no, r, term, trunc, _ = env.step(a)
+    add(o, a, r, no, float(term))  # truncation bootstraps (d=0)
+    o = no
+    if term or trunc:
+        o, _ = env.reset(seed=SEED + 1 + ep)
+        ep += 1
+    if t % TGT_INTERVAL == 0:
+        q_tgt.load_state_dict(q.state_dict())
+    if t >= LEARN_START and t % TRAIN_FREQ == 0:
+        train_burst()
+    if t % 2_000 == 0:
+        print(f"t={t} eval={evaluate():.1f}", flush=True)
+print("final10:", evaluate(10))

@@ -45,7 +45,8 @@ def test_multi_device_matches_single_device():
 
 
 def test_target_refresh_period():
-    s = _solver(8, target_update_period=3)
+    # pin hard-copy semantics: the preset may default to Polyak (target_tau)
+    s = _solver(8, target_update_period=3, target_tau=0.0)
     rng = np.random.default_rng(1)
     import jax
     tgt0 = [np.asarray(x) for x in

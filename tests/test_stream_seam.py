@@ -1,0 +1,98 @@
+"""Actor-restart stream seam: a writer identity change seals the stream's
+slot so no sampled stack / n-step window straddles the dead actor's
+half-episode and the replacement's first episode (VERDICT weak #6)."""
+
+import numpy as np
+
+from distributed_deep_q_tpu.config import ReplayConfig
+from distributed_deep_q_tpu.parallel.mesh import make_mesh, MeshConfig
+from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
+from distributed_deep_q_tpu.replay.multistream import MultiStreamFrameReplay
+from distributed_deep_q_tpu.replay.replay_memory import FrameStackReplay
+from distributed_deep_q_tpu.rpc.replay_server import (
+    ReplayFeedClient, ReplayFeedServer)
+
+
+def _chunk(n, start=0, done_at=None, val=None):
+    """A contiguous frame chunk; frame pixels encode the step index."""
+    done = np.zeros(n, bool)
+    if done_at is not None:
+        done[done_at] = True
+    return {
+        "frame": np.stack([np.full((8, 8), (start + i) % 256, np.uint8)
+                           for i in range(n)]),
+        "action": np.zeros(n, np.int32),
+        "reward": np.ones(n, np.float32),
+        "done": done,
+        "boundary": done.copy(),
+    }
+
+
+def test_seal_stream_marks_boundary_mid_episode():
+    m = FrameStackReplay(64, (8, 8), stack=4, n_step=1, gamma=0.99)
+    for i in range(10):  # half an episode, no boundary
+        m.add(np.full((8, 8), i, np.uint8), 0, 1.0, False)
+    m.seal_stream()
+    assert m.boundary[9] and not m.done[9]
+    for i in range(10, 30):
+        m.add(np.full((8, 8), i, np.uint8), 0, 1.0, False)
+    # stacks anchored just after the seam must zero-fill across it
+    oidx, valid = m._stack_indices(np.array([10, 11, 12, 13]))
+    np.testing.assert_array_equal(
+        valid, [[0, 0, 0, 1], [0, 0, 1, 1], [0, 1, 1, 1], [1, 1, 1, 1]])
+    # n-step windows crossing the truncation-only seam are unsampleable
+    assert m._invalid(np.array([9]))[0]
+
+
+def test_device_ring_reset_stream_seals_current_slot():
+    cfg = ReplayConfig(capacity=1024, batch_size=8, write_chunk=8)
+    mesh = make_mesh(MeshConfig(backend="cpu", num_fake_devices=2))
+    ring = DeviceFrameReplay(cfg, mesh, (8, 8), stack=4, gamma=0.99,
+                             write_chunk=8, num_streams=2)
+    ring.add_batch(_chunk(12), stream=0)      # mid-episode, no boundary
+    slot = ring._slot_cycle[0][0]
+    assert not ring.slots[slot].boundary[:12].any()
+    ring.reset_stream(0)
+    assert ring.slots[slot].boundary[11] and not ring.slots[slot].done[11]
+    # the other stream's slot is untouched
+    other = ring._slot_cycle[1][0]
+    assert not ring.slots[other].boundary.any()
+
+
+def test_rpc_reset_stream_reaches_replay():
+    cfg = ReplayConfig(capacity=1024, batch_size=8, write_chunk=8)
+    mesh = make_mesh(MeshConfig(backend="cpu", num_fake_devices=2))
+    ring = DeviceFrameReplay(cfg, mesh, (8, 8), stack=4, gamma=0.99,
+                             write_chunk=8, num_streams=2)
+    server = ReplayFeedServer(ring)
+    host, port = server.address
+    client = ReplayFeedClient(host, port, actor_id=1)
+    try:
+        client.add_transitions(**_chunk(10))
+        slot = ring._slot_cycle[1][0]
+        assert not ring.slots[slot].boundary[:10].any()
+        # replacement actor announces itself on the same stream id
+        client2 = ReplayFeedClient(host, port, actor_id=1)
+        client2.call("reset_stream")
+        assert ring.slots[slot].boundary[9]
+        client2.close()
+    finally:
+        client.close()
+        server.close()
+
+
+def test_multistream_replay_per_stream_isolation_and_sample():
+    ms = MultiStreamFrameReplay(512, (8, 8), stack=4, n_step=1, gamma=0.99,
+                                num_streams=2, seed=0)
+    for ep in range(4):
+        ms.add_batch(_chunk(20, start=100 * ep, done_at=19), stream=0)
+        ms.add_batch(_chunk(20, start=7 + 100 * ep, done_at=19), stream=1)
+    assert len(ms) == 160
+    assert ms.ready(100)
+    batch = ms.sample(32)
+    assert batch["obs"].shape == (32, 8, 8, 4)
+    assert batch.pop("_sampled_at") == (80, 80)
+    # global indices point back into the owning shard
+    assert (batch["index"] < 2 * ms.shard_cap).all()
+    ms.reset_stream(1)
+    assert ms.shards[1].boundary[(ms.shards[1]._cursor - 1) % ms.shard_cap]
