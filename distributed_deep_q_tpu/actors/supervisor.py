@@ -280,6 +280,14 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
         cfg.replay, priority_beta_steps=cfg.train.total_steps)
 
     solver = Solver(cfg, obs_dim=int(np.prod(obs_shape)))
+    import jax
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "the RPC actor/learner topology is single-controller; for "
+            "multi-host training run the in-process loop on every host "
+            "(train --set mesh.num_processes=N, no --distributed) — each "
+            "host's env feeds its own replay shard and the train step's "
+            "pmean spans hosts (SURVEY §5.8)")
     if pixel and cfg.replay.device_resident:
         replay = DeviceFrameReplay(
             replay_cfg, solver.mesh, obs_shape, cfg.env.stack,

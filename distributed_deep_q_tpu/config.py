@@ -148,9 +148,17 @@ class MeshConfig:
     """
 
     backend: str = "tpu"  # tpu | cpu
-    num_fake_devices: int = 8  # only for backend=cpu
+    num_fake_devices: int = 8  # only for backend=cpu; GLOBAL count when multi-host
     dp: int = 0  # 0 = all available devices on the dp axis
     model: int = 1  # model-parallel axis (hooks only; SURVEY §2.2: TP not needed)
+    # multi-host learner (SURVEY §5.8 third leg, BASELINE config 5):
+    # when num_processes > 1 the mesh spans processes —
+    # ``parallel.multihost.initialize_multihost`` must run before any JAX
+    # backend init. On TPU pods the three fields are usually auto-detected
+    # (leave coordinator empty); on the CPU test backend they are explicit.
+    coordinator: str = ""       # e.g. "10.0.0.1:8476"
+    num_processes: int = 1
+    process_id: int = 0
 
 
 @dataclass

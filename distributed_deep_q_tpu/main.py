@@ -47,6 +47,13 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
     cfg = config_from_args(args)
 
+    # Multi-host bring-up (config 5): when --set mesh.num_processes=N (+
+    # mesh.coordinator, mesh.process_id) is given, every process runs this
+    # same CLI command and connects here, before any backend init. No-op in
+    # the default single-process case.
+    from distributed_deep_q_tpu.parallel.multihost import initialize_multihost
+    initialize_multihost(cfg.mesh)
+
     # Import past flag parsing so --help never initializes JAX backends.
     from distributed_deep_q_tpu.metrics import Metrics
     from distributed_deep_q_tpu.train import evaluate, train_single_process
@@ -72,35 +79,43 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.mode == "eval":
-        from distributed_deep_q_tpu.solver import Solver
-        from distributed_deep_q_tpu.actors.game import make_env
         import numpy as np
+        from distributed_deep_q_tpu.actors.game import make_env
         env = make_env(cfg.env, seed=cfg.train.seed)
         cfg.net.num_actions = env.num_actions
-        solver = Solver(cfg, obs_dim=int(np.prod(env.obs_shape)))
+        solver = _build_solver(cfg, env)
         restored = _maybe_restore(solver, cfg)
-        ret = evaluate(solver, cfg)
+        if cfg.net.kind == "r2d2":
+            from distributed_deep_q_tpu.train import evaluate_recurrent
+            ret = evaluate_recurrent(solver, cfg)
+        else:
+            ret = evaluate(solver, cfg)
         print(json.dumps({"mode": "eval", "eval_return": ret,
                           "episodes": cfg.train.eval_episodes,
                           "restored_step": restored}))
         return 0
 
     if args.mode == "play":
-        from distributed_deep_q_tpu.solver import Solver
-        from distributed_deep_q_tpu.actors.game import FrameStacker, make_env
         import numpy as np
+        from distributed_deep_q_tpu.actors.game import FrameStacker, make_env
         env = make_env(cfg.env, seed=cfg.train.seed)
         cfg.net.num_actions = env.num_actions
-        solver = Solver(cfg, obs_dim=int(np.prod(env.obs_shape)))
+        solver = _build_solver(cfg, env)
         _maybe_restore(solver, cfg)
         rng = np.random.default_rng(cfg.train.seed)
+        recurrent = cfg.net.kind == "r2d2"
+        carry = solver.initial_state(1) if recurrent else None
         stacker = (FrameStacker(env.obs_shape, cfg.env.stack)
                    if env.obs_dtype == np.uint8 else None)
         obs, over, t, ep_ret = env.reset(), False, 0, 0.0
         if stacker:
             obs = stacker.reset(obs)
         while not over:
-            a = solver.act(obs, cfg.actors.eval_eps, rng)
+            if recurrent:
+                a, carry = solver.act(np.asarray(obs), carry,
+                                      cfg.actors.eval_eps, rng)
+            else:
+                a = solver.act(obs, cfg.actors.eval_eps, rng)
             frame, r, _, over = env.step(a)
             obs = stacker.push(frame) if stacker else frame
             ep_ret += r
@@ -110,6 +125,20 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     return 2
+
+
+def _build_solver(cfg, env):
+    """Solver for eval/play: SequenceSolver for recurrent (r2d2) nets, the
+    feed-forward Solver otherwise — a train-mode r2d2 checkpoint must be
+    evaluable/playable from the CLI."""
+    import numpy as np
+    obs_dim = int(np.prod(env.obs_shape))
+    if cfg.net.kind == "r2d2":
+        from distributed_deep_q_tpu.parallel.sequence_learner import (
+            SequenceSolver)
+        return SequenceSolver(cfg, obs_dim=obs_dim)
+    from distributed_deep_q_tpu.solver import Solver
+    return Solver(cfg, obs_dim=obs_dim)
 
 
 if __name__ == "__main__":

@@ -34,6 +34,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_deep_q_tpu.config import TrainConfig
 from distributed_deep_q_tpu.ops.losses import bellman_targets, dqn_loss
 from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
+from distributed_deep_q_tpu.parallel.multihost import (
+    global_batch, put_replicated)
 
 
 class TrainState(flax.struct.PyTreeNode):
@@ -106,7 +108,7 @@ class Learner:
             opt_state=self.opt.init(params),
             step=jnp.zeros((), jnp.int32),
         )
-        return jax.device_put(state, self._replicated)
+        return put_replicated(state, self._replicated)
 
     # -- train step --------------------------------------------------------
 
@@ -214,8 +216,12 @@ class Learner:
     def train_step(self, state: TrainState, batch: dict[str, Any]):
         """One synchronous DP gradient step.
 
-        ``batch`` arrays have global leading dim B (divisible by mesh dp
-        size); returns (new_state, metrics dict of scalars, |TD| [B] for
-        PER priority updates).
+        Single-process: ``batch`` arrays have global leading dim B
+        (divisible by mesh dp size). Multi-host (multi-controller JAX,
+        SURVEY §5.8): each process passes its LOCAL B/process_count rows —
+        its own replay shard's sample — and the global array is assembled
+        here. Returns (new_state, metrics dict of replicated scalars,
+        |TD| [B] batch-sharded, for PER priority updates).
         """
-        return self._train_step(state, batch)
+        return self._train_step(state, global_batch(self._batch_sharding,
+                                                    batch))

@@ -24,16 +24,25 @@ AXIS_MODEL = "model"
 
 
 def _cpu_devices(n: int) -> list[jax.Device]:
-    """Force-create n virtual CPU devices (works pre- or post-backend-init)."""
-    try:
-        # pre-init: steer platform selection (overrides the container's
-        # sitecustomize JAX_PLATFORMS latch). Only ever *raise* the device
-        # count — a small mesh built first must not cap later larger ones.
-        jax.config.update("jax_platforms", "cpu")
-        cur = getattr(jax.config, "jax_num_cpu_devices", -1)
-        jax.config.update("jax_num_cpu_devices", max(cur, n))
-    except Exception:
-        pass
+    """Force-create n virtual CPU devices (works pre- or post-backend-init).
+
+    ``n`` counts GLOBAL devices. In multi-controller mode (multihost
+    learner, SURVEY §5.8) the per-process device count was already fixed by
+    ``initialize_multihost`` — raising it here would inflate the global
+    device count — so the override only runs when no distributed client is
+    connected.
+    """
+    from jax._src import distributed as _dist
+    if _dist.global_state.client is None:
+        try:
+            # pre-init: steer platform selection (overrides the container's
+            # sitecustomize JAX_PLATFORMS latch). Only ever *raise* the device
+            # count — a small mesh built first must not cap later larger ones.
+            jax.config.update("jax_platforms", "cpu")
+            cur = getattr(jax.config, "jax_num_cpu_devices", -1)
+            jax.config.update("jax_num_cpu_devices", max(cur, n))
+        except Exception:
+            pass
     devs = jax.devices("cpu")
     if len(devs) < n:
         raise RuntimeError(

@@ -41,6 +41,8 @@ from distributed_deep_q_tpu.ops.losses import (
 from distributed_deep_q_tpu.parallel.learner import (
     TrainState, make_optimizer, refresh_target)
 from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
+from distributed_deep_q_tpu.parallel.multihost import (
+    global_batch, put_replicated)
 
 
 class SequenceLearner:
@@ -54,6 +56,7 @@ class SequenceLearner:
         self.mesh = mesh
         self.opt = make_optimizer(cfg)
         self._replicated = NamedSharding(mesh, P())
+        self._batch_sharding = NamedSharding(mesh, P(AXIS_DP))
         self._train_step = self._build_train_step()
 
     def init_state(self, params: Any) -> TrainState:
@@ -63,7 +66,7 @@ class SequenceLearner:
             opt_state=self.opt.init(params),
             step=jnp.zeros((), jnp.int32),
         )
-        return jax.device_put(state, self._replicated)
+        return put_replicated(state, self._replicated)
 
     def _build_train_step(self):
         cfg, burn = self.cfg, self.burn_in
@@ -136,8 +139,11 @@ class SequenceLearner:
 
     def train_step(self, state: TrainState, batch: dict[str, Any]):
         """One synchronous DP step over a [B, T_total(+1)] sequence batch;
-        returns (state, metrics, per-sequence priority [B])."""
-        return self._train_step(state, batch)
+        returns (state, metrics, per-sequence priority [B]). In multi-host
+        mode each process passes its local B/process_count sequences (same
+        contract as ``Learner.train_step``)."""
+        return self._train_step(state, global_batch(self._batch_sharding,
+                                                    batch))
 
 
 class SequenceSolver:

@@ -55,36 +55,67 @@ def evaluate(solver: Solver, cfg: Config, episodes: int | None = None,
 
 def train_single_process(cfg: Config, metrics: Metrics | None = None,
                          log_every: int = 1_000) -> dict:
-    """Run config-1-style training; returns final summary metrics."""
+    """Run config-1-style training; returns final summary metrics.
+
+    Multi-host (config 5, SURVEY §5.8): when the process was connected via
+    ``initialize_multihost``, every host runs this same loop — its own env
+    (seed-offset per process) feeding its own replay shard, sampling its
+    ``batch_size/process_count`` local rows into the global-mesh train step
+    whose ``lax.pmean`` spans hosts. The learn gate opens only when every
+    host's shard is warm (``all_processes_ready``) so no process enters the
+    collective step early.
+    """
     if cfg.net.kind == "r2d2":
         return train_recurrent(cfg, metrics, log_every)
     metrics = metrics or Metrics()
+    # NOTE: solver/env construction initializes the JAX backend; only then
+    # is process topology safe to query (probing earlier would pre-empt the
+    # --backend platform selection).
     env = make_env(cfg.env, seed=cfg.train.seed)
     cfg.net.num_actions = env.num_actions
     obs_dim = int(np.prod(env.obs_shape))
     solver = Solver(cfg, obs_dim=obs_dim)
-    rng = np.random.default_rng(cfg.train.seed)
+    pc, pid = jax.process_count(), jax.process_index()
+    local_batch = cfg.replay.batch_size
+    if pc > 1:
+        from distributed_deep_q_tpu.parallel.multihost import (
+            all_processes_ready, local_rows)
+        if cfg.replay.batch_size % pc:
+            raise ValueError(f"replay.batch_size={cfg.replay.batch_size} "
+                             f"must divide across {pc} processes")
+        local_batch = cfg.replay.batch_size // pc
+        # decorrelate the per-host experience streams
+        env = make_env(cfg.env, seed=cfg.train.seed + 131 * pid)
+        if pid != 0:
+            metrics = Metrics()  # file/TB sinks live on process 0 only
+    rng = np.random.default_rng(cfg.train.seed + 131 * pid)
 
+    seed = cfg.train.seed + 131 * pid
     pixel_env = env.obs_dtype == np.uint8
     if pixel_env:
         if cfg.replay.device_resident:
+            if pc > 1:
+                raise ValueError(
+                    "replay.device_resident=True is single-controller only "
+                    "(the host writes frames into a mesh-sharded HBM ring); "
+                    "multi-host pixel runs need replay.device_resident=false")
             # TPU-first data path: frames live in HBM, the step gathers
             # stacks on device; PER (when enabled) is handled per shard
             # inside DeviceFrameReplay
             replay = DeviceFrameReplay(
                 cfg.replay, solver.mesh, env.obs_shape, cfg.env.stack,
-                cfg.train.gamma, seed=cfg.train.seed,
+                cfg.train.gamma, seed=seed,
                 write_chunk=cfg.replay.write_chunk)
         else:
             replay = maybe_prioritize(FrameStackReplay(
                 cfg.replay.capacity, env.obs_shape, cfg.env.stack,
-                cfg.replay.n_step, cfg.train.gamma, seed=cfg.train.seed),
-                cfg.replay, seed=cfg.train.seed)
+                cfg.replay.n_step, cfg.train.gamma, seed=seed),
+                cfg.replay, seed=seed)
         stacker = FrameStacker(env.obs_shape, cfg.env.stack)
     else:
         replay = maybe_prioritize(ReplayMemory(
             cfg.replay.capacity, env.obs_shape, np.float32,
-            seed=cfg.train.seed), cfg.replay, seed=cfg.train.seed)
+            seed=seed), cfg.replay, seed=seed)
         nstep = NStepAccumulator(cfg.replay.n_step, cfg.train.gamma)
 
     frame = env.reset()
@@ -92,6 +123,7 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
     ep_ret, ep_returns = 0.0, MovingAverage(100)
     summary: dict = {}
     pending = None  # (index, td_abs, sampled_at) awaiting PER write-back
+    learn_live = False  # latched once warm (all shards warm, multi-host)
     gsteps = 0
     best_eval, best_params = float("-inf"), None
     timer = StepTimer()
@@ -138,12 +170,18 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
                     obs = frame
                     nstep.reset()
 
-            if (replay.ready(cfg.replay.learn_start)
-                    and t % cfg.train.train_every == 0):
+            if t % cfg.train.train_every == 0 and not learn_live:
+                # the ready latch: single-process = local fill check;
+                # multi-host = every process's shard warm (collective AND,
+                # called at the same loop point on every host)
+                ready = replay.ready(cfg.replay.learn_start)
+                learn_live = (ready if pc == 1
+                              else all_processes_ready(ready))
+            if learn_live and t % cfg.train.train_every == 0:
                 # learn phase: j minibatches per k env steps (SURVEY §3.1 [M])
                 for _ in range(cfg.train.grad_steps_per_train):
                     with timer.phase("sample"):
-                        batch = replay.sample(cfg.replay.batch_size)
+                        batch = replay.sample(local_batch)
                     sampled_at = batch.pop("_sampled_at", replay.steps_added)
                     with timer.phase("dispatch"):
                         if isinstance(replay, DeviceFrameReplay):
@@ -157,10 +195,12 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
                         # one-step-delayed priority write-back: materializing
                         # |TD| for the *previous* step is free by now (its
                         # device work is done), so the fresh step is never
-                        # host-blocked
+                        # host-blocked. Multi-host: each process writes back
+                        # only its own rows, into its own shard.
                         if pending is not None:
-                            replay.update_priorities(pending[0],
-                                                     np.asarray(pending[1]),
+                            td = (np.asarray(pending[1]) if pc == 1
+                                  else local_rows(pending[1]))
+                            replay.update_priorities(pending[0], td,
                                                      sampled_at=pending[2])
                         pending = (m["index"], m["td_abs"], sampled_at)
                     metrics.count("grad_steps")

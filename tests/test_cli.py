@@ -1,0 +1,48 @@
+"""CLI round-trip tests (SURVEY.md §1 L6 "CLI / config / entry" [M]).
+
+VERDICT round 2 weak #6: a recurrent (r2d2) checkpoint written by train
+mode must be evaluable AND playable from the CLI — eval/play dispatch to
+``SequenceSolver`` / ``evaluate_recurrent`` instead of crashing in the
+feed-forward ``Solver``.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from distributed_deep_q_tpu.main import main
+
+R2D2_TINY = [
+    "--set",
+    "net.torso=mlp", "net.lstm_size=16", "net.hidden=32",
+    "replay.sequence_length=8", "replay.burn_in=2", "replay.batch_size=8",
+    "replay.capacity=2000", "replay.learn_start=64",
+    "replay.prioritized=false",
+    "train.total_steps=250", "train.eval_episodes=2",
+    "env.id=CartPole-v1", "env.kind=gym", "env.stack=1",
+    "actors.num_actors=1",
+]
+
+
+@pytest.mark.slow
+def test_r2d2_checkpoint_roundtrips_through_cli(tmp_path, capsys):
+    ckpt = str(tmp_path / "ckpt")
+    common = ["--preset", "r2d2", "--backend", "cpu"]
+    extra = [f"train.checkpoint_dir={ckpt}", "train.checkpoint_every=100"]
+
+    assert main(["train", *common, *R2D2_TINY, *extra]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["mode"] == "train"
+
+    assert main(["eval", *common, *R2D2_TINY, *extra]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["mode"] == "eval"
+    assert out["restored_step"] is not None and out["restored_step"] > 0
+    assert out["eval_return"] >= 0.0
+
+    assert main(["play", *common, *R2D2_TINY, *extra]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["mode"] == "play"
+    assert out["steps"] > 0

@@ -1,0 +1,158 @@
+"""Multi-host learner tests (SURVEY.md §5.8 third leg, BASELINE config 5).
+
+The capability under test: ``jax.distributed.initialize`` + a global mesh
+spanning processes, with the SAME shard_map/pmean train step the
+single-host learner uses. The reference scaled across nodes with Spark
+``local[N]`` as its no-cluster test mode (SURVEY §4); the rebuilt analogue
+spawns N real OS processes on this box, each owning 8/N virtual CPU
+devices, connected through the JAX coordination service with gloo
+cross-process collectives.
+
+The equivalence bar (VERDICT round 2 #1): a 2-process × 4-device run must
+produce the same final replicated parameters as the single-process
+8-device run on identical seeds and identical global batches.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_multihost_worker.py")
+STEPS = 5
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_workers(nproc: int, out: str, steps: int = STEPS) -> None:
+    """Spawn nproc copies of the worker (multi-controller SPMD) and wait."""
+    port = _free_port()
+    env = dict(os.environ)
+    # the workers pin platform/device-count themselves (initialize_multihost);
+    # scrub leftovers that could pre-initialize the wrong backend
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(pid), str(nproc), str(port), out,
+             str(steps)],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for pid in range(nproc)
+    ]
+    outs = [p.communicate(timeout=300) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, (
+            f"worker failed rc={p.returncode}\nstdout:{so.decode()[-2000:]}\n"
+            f"stderr:{se.decode()[-2000:]}")
+
+
+def test_two_process_matches_single_process(tmp_path):
+    """2 procs × 4 devices == 1 proc × 8 devices, identical final params."""
+    ref = str(tmp_path / "ref.npz")
+    two = str(tmp_path / "two.npz")
+    _run_workers(1, ref)
+    _run_workers(2, two)
+
+    a, b = np.load(ref), np.load(two)
+    assert set(a.files) == set(b.files)
+    for k in a.files:
+        # the grad pmean crosses a process boundary in the 2-proc run, so
+        # reduction topology may differ; demand float32-tight agreement
+        np.testing.assert_allclose(
+            a[k], b[k], rtol=0, atol=1e-6,
+            err_msg=f"param leaf {k} diverged between 1-proc and 2-proc runs")
+    exact = all(np.array_equal(a[k], b[k]) for k in a.files)
+    # record bitwise status in the test output (informational)
+    print(f"bitwise_identical={exact}")
+
+
+def test_initialize_multihost_noop_single_process():
+    """num_processes<=1 must be a no-op so single-host paths can call it
+    unconditionally (and must not touch the already-initialized backend)."""
+    import jax
+
+    from distributed_deep_q_tpu.config import MeshConfig
+    from distributed_deep_q_tpu.parallel.multihost import (
+        initialize_multihost, local_rows)
+
+    before = jax.device_count()
+    initialize_multihost(MeshConfig(backend="cpu", num_processes=1))
+    assert jax.device_count() == before
+
+    # local_rows on a single-process sharded array returns all rows in order
+    x = np.arange(16, dtype=np.float32)
+    arr = jax.device_put(x)
+    np.testing.assert_array_equal(local_rows(arr), x)
+
+
+def test_dryrun_multichip_two_process():
+    """The driver's dryrun entry runs in multi-process mode when the DDQ_*
+    env vars are present — 2 processes × 4 devices, full train step incl.
+    the sequence (R2D2) learner."""
+    port = _free_port()
+    code = ("import sys; sys.path.insert(0, %r); "
+            "from __graft_entry__ import dryrun_multichip; "
+            "dryrun_multichip(8)" % REPO)
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update(DDQ_COORDINATOR=f"127.0.0.1:{port}",
+                   DDQ_NUM_PROCESSES="2", DDQ_PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", code], cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    outs = [p.communicate(timeout=300) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, (
+            f"dryrun proc failed rc={p.returncode}\n{se.decode()[-2000:]}")
+
+
+def test_cli_train_two_process():
+    """End-to-end: the CLI runs the SAME command on two processes (only
+    process_id differs) and trains CartPole across a 2-host global mesh —
+    per-host env + replay shard, cross-host pmean, synchronized learn gate."""
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "distributed_deep_q_tpu.main", "train",
+             "--preset", "cartpole", "--backend", "cpu",
+             "--set", f"mesh.coordinator=127.0.0.1:{port}",
+             "mesh.num_processes=2", f"mesh.process_id={pid}",
+             "mesh.num_fake_devices=8",
+             "train.total_steps=600", "replay.learn_start=200",
+             "train.eval_every=0", "train.keep_best_eval=false",
+             "train.eval_episodes=2", "replay.batch_size=64"],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    outs = [p.communicate(timeout=420) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, (
+            f"CLI proc failed rc={p.returncode}\n{se.decode()[-2000:]}")
+    import json
+    summary = json.loads(outs[0][0].decode().strip().splitlines()[-1])
+    assert summary["mode"] == "train"
+    assert "eval_return" in summary
+
+
+def test_uneven_device_split_rejected():
+    from distributed_deep_q_tpu.config import MeshConfig
+    from distributed_deep_q_tpu.parallel.multihost import initialize_multihost
+
+    with pytest.raises(ValueError, match="divide evenly"):
+        initialize_multihost(MeshConfig(backend="cpu", num_fake_devices=8,
+                                        num_processes=3,
+                                        coordinator="127.0.0.1:1"))
