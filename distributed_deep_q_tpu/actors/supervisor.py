@@ -67,6 +67,11 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     eps = actor_epsilon(actor_id, cfg.actors.num_actors,
                         cfg.actors.eps_base, cfg.actors.eps_alpha)
 
+    if cfg.net.kind == "r2d2":
+        _recurrent_actor_loop(cfg, env, qnet, client, rng, eps, stop_event,
+                              max_env_steps)
+        return
+
     pixel = env.obs_dtype == np.uint8
     stacker = FrameStacker(env.obs_shape, cfg.env.stack) if pixel else None
     nstep = (None if pixel else
@@ -172,6 +177,106 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
         client.close()
 
 
+def _recurrent_actor_loop(cfg: Config, env, qnet, client, rng, eps: float,
+                          stop_event, max_env_steps: int = 0) -> None:
+    """R2D2 actor body: thread LSTM state through the episode, assemble
+    overlapping sequences with the stored start-of-window carry
+    (``SequenceBuilder``), and ship whole sequences over the RPC boundary.
+
+    The carry ALWAYS advances (even on random actions) so the carry stored
+    with each sequence matches what the policy network actually saw — the
+    stored-state burn-in strategy (SURVEY §5.7) is meaningless otherwise.
+    """
+    from distributed_deep_q_tpu.actors.game import FrameStacker
+    from distributed_deep_q_tpu.replay.sequence import SequenceBuilder
+
+    pixel = env.obs_dtype == np.uint8
+    stacker = FrameStacker(env.obs_shape, cfg.env.stack) if pixel else None
+    obs_shape = (tuple(env.obs_shape) + (cfg.env.stack,)) if pixel \
+        else tuple(env.obs_shape)
+    obs_dtype = np.uint8 if pixel else np.float32
+    builder = SequenceBuilder(cfg.replay.sequence_length, cfg.replay.burn_in,
+                              obs_shape, obs_dtype, cfg.net.lstm_size,
+                              cfg.train.gamma)
+    # one RPC message per ~send_batch transitions, in whole-sequence units
+    period = max(cfg.replay.sequence_length - cfg.replay.burn_in, 1)
+    send_seqs = max(1, cfg.actors.send_batch // period)
+
+    seqs: list[dict] = []
+    ep_returns: list[float] = []
+    episodes = 0
+    env_steps_since = 0
+    version = -1
+    steps = 0
+
+    def flush() -> None:
+        nonlocal episodes, env_steps_since
+        if not seqs:
+            return
+        payload: dict = {k: np.stack([s[k] for s in seqs]) for k in seqs[0]}
+        payload["episodes"] = episodes
+        payload["ep_returns"] = np.asarray(ep_returns, np.float32)
+        payload["env_steps"] = env_steps_since
+        client.add_transitions(**payload)
+        seqs.clear()
+        ep_returns.clear()
+        episodes = 0
+        env_steps_since = 0
+
+    frame = env.reset()
+    obs = stacker.reset(frame) if pixel else frame
+    carry = qnet.initial_state(1)
+    ep_ret = 0.0
+    try:
+        while not stop_event.is_set():
+            if max_env_steps and steps >= max_env_steps:
+                break
+            if steps % cfg.actors.param_sync_period == 0:
+                new_version, weights = client.get_params(have_version=version)
+                if weights is not None:
+                    qnet.set_weights(weights)
+                    version = new_version
+
+            carry_before = carry
+            q, carry = qnet.forward(np.asarray(obs)[None, None], carry)
+            if rng.random() < eps:
+                a = int(rng.integers(env.num_actions))
+            else:
+                a = int(np.argmax(np.asarray(q)[0, 0]))
+            next_frame, r, done, over = env.step(a)
+            next_obs = stacker.push(next_frame) if pixel else next_frame
+            ep_ret += r
+            steps += 1
+            env_steps_since += 1
+            seqs.extend(builder.on_step(
+                obs, a, r, done,
+                (np.asarray(carry_before[0])[0],
+                 np.asarray(carry_before[1])[0]),
+                next_obs))
+            obs = next_obs
+
+            if over:
+                if not done:
+                    # time-limit truncation: ship the window tail with its
+                    # bootstrap intact
+                    seqs.extend(builder.flush_truncated(next_obs))
+                ep_returns.append(ep_ret)
+                episodes += 1
+                ep_ret = 0.0
+                builder.reset()
+                frame = env.reset()
+                obs = stacker.reset(frame) if pixel else frame
+                carry = qnet.initial_state(1)
+
+            if len(seqs) >= send_seqs:
+                flush()
+        flush()
+    except (ConnectionError, OSError):
+        pass  # learner gone; supervisor owns our lifecycle
+    finally:
+        client.close()
+
+
 # ---------------------------------------------------------------------------
 # Supervisor (failure detection, SURVEY §5.3)
 # ---------------------------------------------------------------------------
@@ -259,6 +364,9 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
 
     from distributed_deep_q_tpu.actors.game import make_env
     from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
+
+    if cfg.net.kind == "r2d2":
+        return _train_distributed_recurrent(cfg, metrics, log_every)
     from distributed_deep_q_tpu.replay.multistream import MultiStreamFrameReplay
     from distributed_deep_q_tpu.replay.prioritized import maybe_prioritize
     from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
@@ -404,6 +512,106 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
 
     summary["final_return_avg100"] = server.mean_recent_return()
     summary["eval_return"] = evaluate(solver, cfg)
+    summary["env_steps"] = server.env_steps
+    summary["actor_restarts"] = sup.restarts
+    summary["solver"] = solver
+    return summary
+
+
+def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
+                                 log_every: int = 500) -> dict:
+    """Distributed R2D2 (config 5): recurrent actors over RPC → sequence
+    replay → mesh sequence learner.
+
+    Actors run the full recurrent policy (LSTM state threaded through the
+    episode) and ship whole sequences with their stored start carry; the
+    learner samples sequence batches under the server's replay lock — the
+    ``SequenceReplay`` store is host-side and ``sample`` copies rows, so the
+    lock covers only the sample/priority write-back, never device execution.
+    """
+    from distributed_deep_q_tpu.actors.game import make_env
+    from distributed_deep_q_tpu.parallel.sequence_learner import SequenceSolver
+    from distributed_deep_q_tpu.replay.sequence import SequenceReplay
+    from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedServer
+    from distributed_deep_q_tpu.train import evaluate_recurrent
+    from distributed_deep_q_tpu.utils.checkpoint import maybe_checkpointer
+
+    metrics = metrics or Metrics()
+    probe = make_env(cfg.env, seed=cfg.train.seed)
+    cfg.net.num_actions = probe.num_actions
+    pixel = probe.obs_dtype == np.uint8
+    obs_shape = (tuple(probe.obs_shape) + (cfg.env.stack,)) if pixel \
+        else tuple(probe.obs_shape)
+    obs_dtype = np.uint8 if pixel else np.float32
+    obs_dim = int(np.prod(probe.obs_shape))
+    del probe
+
+    solver = SequenceSolver(cfg, obs_dim=obs_dim)
+    seq_len = cfg.replay.sequence_length
+    # transition-denominated config fields scale down to sequence units;
+    # β anneal runs per sample() = per grad step in this topology
+    replay = SequenceReplay(
+        max(cfg.replay.capacity // seq_len, 64), seq_len, obs_shape,
+        obs_dtype, cfg.net.lstm_size, prioritized=cfg.replay.prioritized,
+        alpha=cfg.replay.priority_alpha, beta0=cfg.replay.priority_beta0,
+        beta_steps=cfg.train.total_steps, eps=cfg.replay.priority_eps,
+        seed=cfg.train.seed, use_native=cfg.replay.use_native)
+    learn_start_seqs = max(cfg.replay.learn_start // seq_len, 2)
+
+    server = ReplayFeedServer(replay, host=cfg.actors.host, port=0)
+    server.publish_params(solver.get_weights())
+    host, port = server.address
+
+    sup = ActorSupervisor(cfg, host, port)
+    sup.start()
+    sup.watch(server.last_seen)
+
+    ckpt = maybe_checkpointer(cfg.train)
+    if ckpt and cfg.train.resume and ckpt.latest_step() is not None:
+        solver.state, _ = ckpt.restore(solver.state)
+        server.publish_params(solver.get_weights())
+
+    pending = None
+    summary: dict = {}
+    try:
+        while not replay.ready(learn_start_seqs):
+            time.sleep(0.05)
+        for gstep in range(1, cfg.train.total_steps + 1):
+            with server.replay_lock:
+                batch = replay.sample(cfg.replay.batch_size)
+                sampled_at = batch.pop("_sampled_at")
+            m = solver.train_step(batch)
+            metrics.count("grad_steps")
+
+            if replay.prioritized:
+                if pending is not None:
+                    with server.replay_lock:
+                        replay.update_priorities(
+                            pending[0], np.asarray(pending[1]),
+                            sampled_at=pending[2])
+                pending = (m["index"], m["td_abs"], sampled_at)
+
+            if gstep % cfg.actors.param_sync_period == 0:
+                server.publish_params(solver.get_weights())
+            if ckpt and gstep % cfg.train.checkpoint_every == 0:
+                ckpt.save(solver.state, extra={"env_steps": server.env_steps})
+            if gstep % log_every == 0:
+                summary = {
+                    "loss": float(m["loss"]),
+                    "q_mean": float(m["q_mean"]),
+                    "return_avg100": server.mean_recent_return(),
+                    "env_steps": server.env_steps,
+                    "replay_size": len(replay),
+                    "grad_steps_per_s": metrics.rate("grad_steps"),
+                    "actor_restarts": sup.restarts,
+                }
+                metrics.log(gstep, **summary)
+    finally:
+        sup.stop()
+        server.close()
+
+    summary["final_return_avg100"] = server.mean_recent_return()
+    summary["eval_return"] = evaluate_recurrent(solver, cfg)
     summary["env_steps"] = server.env_steps
     summary["actor_restarts"] = sup.restarts
     summary["solver"] = solver

@@ -6,7 +6,8 @@ that boundary, rebuilt: a threaded raw-TCP service colocated with the
 learner, speaking ``rpc/protocol.py`` messages:
 
 - ``add_transitions`` — actors push transition chunks (pixel streams carry
-  frames + episode flags; vector streams carry explicit n-step transitions).
+  frames + episode flags; vector streams carry explicit n-step transitions;
+  recurrent actors carry whole R2D2 sequences with their stored LSTM carry).
   Each actor stream id pins to a replay shard so the device ring's temporal
   adjacency invariant holds.
 - ``get_params``      — actors pull fresh θ every ~``param_sync_period`` env
@@ -110,7 +111,15 @@ class ReplayFeedServer:
 
         if method == "add_transitions":
             with self.replay_lock:
-                if "frame" in req:  # pixel stream → frame/device ring
+                if "init_c" in req:  # R2D2 sequence batch → SequenceReplay
+                    # leading dim = sequence count; env-step accounting comes
+                    # from the actor (overlapping windows would double-count)
+                    self.replay.add_batch(
+                        {k: req[k] for k in
+                         ("obs", "action", "reward", "discount", "mask",
+                          "init_c", "init_h")})
+                    n = int(req.get("env_steps", len(req["action"])))
+                elif "frame" in req:  # pixel stream → frame/device ring
                     n = len(req["action"])
                     batch = {k: req[k] for k in
                              ("frame", "action", "reward", "done", "boundary")

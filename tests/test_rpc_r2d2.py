@@ -1,0 +1,132 @@
+"""Distributed R2D2 tests (VERDICT round-2 ask #3, BASELINE config 5):
+sequence payloads over the ReplayFeed boundary, the recurrent actor →
+sequence replay → sequence learner topology end-to-end on loopback, and
+fault injection (kill-an-actor) on the recurrent path."""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributed_deep_q_tpu.config import r2d2_config
+from distributed_deep_q_tpu.replay.sequence import SequenceReplay
+from distributed_deep_q_tpu.rpc.replay_server import (
+    ReplayFeedClient, ReplayFeedServer)
+
+
+def _small_r2d2_cfg():
+    """CartPole-shaped r2d2 config small enough for loopback CI."""
+    cfg = r2d2_config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.num_fake_devices = 2
+    cfg.env.id = "CartPole-v1"
+    cfg.env.kind = "gym"
+    cfg.env.stack = 1
+    cfg.env.reward_clip = 0.0
+    cfg.net.torso = "mlp"
+    cfg.net.hidden = (32,)
+    cfg.net.lstm_size = 16
+    cfg.net.compute_dtype = "float32"
+    cfg.replay.sequence_length = 8
+    cfg.replay.burn_in = 4
+    cfg.replay.batch_size = 8
+    cfg.replay.capacity = 8 * 256      # 256 sequences
+    cfg.replay.learn_start = 8 * 6     # 6 sequences
+    cfg.actors.num_actors = 2
+    cfg.actors.send_batch = 8
+    cfg.actors.param_sync_period = 20
+    return cfg
+
+
+def _fake_sequences(n, t=8, obs_dim=4, lstm=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "obs": rng.standard_normal((n, t + 1, obs_dim)).astype(np.float32),
+        "action": rng.integers(0, 2, (n, t)).astype(np.int32),
+        "reward": rng.standard_normal((n, t)).astype(np.float32),
+        "discount": np.full((n, t), 0.99, np.float32),
+        "mask": np.ones((n, t), np.float32),
+        "init_c": rng.standard_normal((n, lstm)).astype(np.float32),
+        "init_h": rng.standard_normal((n, lstm)).astype(np.float32),
+    }
+
+
+def test_sequence_payload_over_rpc():
+    """add_transitions with an init_c key routes to SequenceReplay.add_batch
+    and env-step accounting uses the actor's explicit count (overlapping
+    windows would double-count otherwise)."""
+    replay = SequenceReplay(64, 8, (4,), np.float32, lstm_size=16)
+    server = ReplayFeedServer(replay)
+    host, port = server.address
+    client = ReplayFeedClient(host, port, actor_id=1)
+    try:
+        seqs = _fake_sequences(5)
+        resp = client.add_transitions(
+            **seqs, env_steps=20, episodes=1,
+            ep_returns=np.asarray([12.0], np.float32))
+        assert resp["ok"] and resp["env_steps"] == 20
+        assert len(replay) == 5
+        assert server.episodes == 1
+        np.testing.assert_array_equal(replay.obs[:5], seqs["obs"])
+        np.testing.assert_array_equal(replay.init_c[:5], seqs["init_c"])
+        stats = client.call("stats")
+        assert stats["replay_size"] == 5 and stats["env_steps"] == 20
+    finally:
+        client.close()
+        server.close()
+
+
+@pytest.mark.slow
+def test_distributed_r2d2_end_to_end():
+    """Full recurrent topology on loopback: 2 recurrent actor processes
+    shipping sequences with stored LSTM carries, learner running the
+    sharded sequence step with PER write-back, θ publish via RPC."""
+    from distributed_deep_q_tpu.actors.supervisor import train_distributed
+
+    cfg = _small_r2d2_cfg()
+    cfg.train.total_steps = 40
+    summary = train_distributed(cfg, log_every=20)
+    assert summary["solver"].step == 40
+    assert np.isfinite(summary["loss"])
+    assert summary["env_steps"] >= cfg.replay.learn_start
+    assert summary["actor_restarts"] == 0
+    assert np.isfinite(summary["eval_return"])
+
+
+@pytest.mark.slow
+def test_r2d2_kill_an_actor():
+    """Fault injection on the recurrent path: kill a recurrent actor mid-run;
+    the supervisor must respawn it and sequences must keep flowing."""
+    from distributed_deep_q_tpu.actors.supervisor import ActorSupervisor
+
+    cfg = _small_r2d2_cfg()
+    cfg.actors.num_actors = 1
+
+    replay = SequenceReplay(512, cfg.replay.sequence_length, (4,), np.float32,
+                            lstm_size=cfg.net.lstm_size)
+    server = ReplayFeedServer(replay)
+    host, port = server.address
+    sup = ActorSupervisor(cfg, host, port)
+    try:
+        sup.start()
+        sup.watch(server.last_seen, poll_period=0.2)
+        deadline = time.monotonic() + 120
+        while len(replay) < 10 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert len(replay) >= 10, "recurrent actor never shipped sequences"
+
+        sup.procs[0].kill()
+        deadline = time.monotonic() + 120
+        while sup.restarts == 0 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert sup.restarts >= 1, "supervisor never restarted the dead actor"
+
+        size_after = len(replay)
+        deadline = time.monotonic() + 120
+        while len(replay) <= size_after + 5 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert len(replay) > size_after + 5, \
+            "replacement recurrent actor never fed the buffer"
+    finally:
+        sup.stop()
+        server.close()
