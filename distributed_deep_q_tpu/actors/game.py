@@ -104,6 +104,57 @@ class FakeAtari:
         return self._frame(), reward, done, done
 
 
+class SignalAtari:
+    """Pixel env whose reward is a function of what's ON SCREEN — the
+    learnability probe for the CNN + device-ring path.
+
+    Each observation shows one bright band (out of ``num_actions`` bands;
+    vertical or horizontal per ``orientation``) on a dark background; acting
+    with the band's index pays +1, anything else 0, and a new band is drawn
+    uniformly each step. Q*(s, a) = 1 for the shown band and γ·E[max Q]
+    elsewhere — a contextual bandit: the policy must READ THE PIXELS to beat
+    the 1/num_actions random-policy return, which is exactly what FakeAtari
+    (counter frames, action-independent reward) cannot test. Orientation
+    variants are distinct "games" for multi-game fleets (config 4).
+    """
+
+    def __init__(self, episode_len: int = 32, num_actions: int = 4,
+                 frame_shape: tuple[int, int] = (84, 84), seed: int = 0,
+                 orientation: str = "v"):
+        assert orientation in ("v", "h")
+        self.episode_len = int(episode_len)
+        self.num_actions = int(num_actions)
+        self.obs_shape = tuple(frame_shape)
+        self.obs_dtype = np.uint8
+        self.orientation = orientation
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._target = 0
+
+    def _frame(self) -> np.ndarray:
+        f = np.full(self.obs_shape, 20, np.uint8)
+        h, w = self.obs_shape
+        if self.orientation == "v":
+            band = w // self.num_actions
+            f[:, self._target * band:(self._target + 1) * band] = 220
+        else:
+            band = h // self.num_actions
+            f[self._target * band:(self._target + 1) * band, :] = 220
+        return f
+
+    def reset(self) -> np.ndarray:
+        self._t = 0
+        self._target = int(self._rng.integers(self.num_actions))
+        return self._frame()
+
+    def step(self, action: int):
+        self._t += 1
+        reward = 1.0 if int(action) == self._target else 0.0
+        self._target = int(self._rng.integers(self.num_actions))
+        done = self._t >= self.episode_len
+        return self._frame(), reward, done, done
+
+
 # ---------------------------------------------------------------------------
 # Atari (ALE) with canonical DQN preprocessing
 # ---------------------------------------------------------------------------
@@ -143,19 +194,25 @@ class AtariEnv:
     ``EnvConfig`` and tested as constants.
     """
 
-    def __init__(self, cfg: EnvConfig, seed: int = 0):
-        try:
-            import ale_py  # noqa: F401
-            import gymnasium
-        except ImportError as e:  # pragma: no cover - exercised only sans ALE
-            raise ImportError(
-                "AtariEnv requires ale_py (not installed in this image); "
-                "use FakeAtari for tests or install ale-py on actor hosts"
-            ) from e
-        import gymnasium
-
+    def __init__(self, cfg: EnvConfig, seed: int = 0, env=None):
+        """``env`` injects a pre-built gymnasium-compatible raw env (RGB
+        frames + ``lives`` info) — the test seam that lets the whole
+        preprocessing stack execute without ALE installed."""
+        if env is None:
+            try:
+                import ale_py  # noqa: F401
+                import gymnasium
+            except ImportError as e:  # pragma: no cover - needs ALE absent
+                raise ImportError(
+                    "AtariEnv requires ale_py (not installed in this image); "
+                    "use FakeAtari for tests or install ale-py on actor hosts"
+                ) from e
+            kwargs = ({"full_action_space": True}
+                      if cfg.full_action_space else {})
+            env = gymnasium.make(cfg.id, frameskip=1,
+                                 repeat_action_probability=0.0, **kwargs)
         self.cfg = cfg
-        self._env = gymnasium.make(cfg.id, frameskip=1, repeat_action_probability=0.0)
+        self._env = env
         self._seed = seed
         self._n_resets = 0
         self._rng = np.random.default_rng(seed)
@@ -216,6 +273,11 @@ def make_env(cfg: EnvConfig, seed: int = 0) -> Env:
         return AtariEnv(cfg, seed)
     if cfg.kind == "fake_atari":
         return FakeAtari(frame_shape=cfg.frame_shape)
+    if cfg.kind == "signal_atari":
+        # id "signal" = vertical bands, "signal-h" = horizontal — two
+        # distinct fake "games" for multi-game fleet tests
+        return SignalAtari(frame_shape=cfg.frame_shape, seed=seed,
+                           orientation="h" if cfg.id.endswith("-h") else "v")
     raise ValueError(f"unknown env kind {cfg.kind!r}")
 
 

@@ -34,6 +34,29 @@ def actor_epsilon(i: int, n: int, base: float, alpha: float) -> float:
     return float(base ** (1.0 + i * alpha / (n - 1)))
 
 
+def _probe_envs(cfg: Config):
+    """Probe every configured game once: verifies the fleet shares ONE
+    action space (a single Q-head serves all games — config 4's multi-game
+    mode needs ``env.full_action_space`` for ALE) and returns the first
+    game's probe env for shape/dtype discovery."""
+    from distributed_deep_q_tpu.actors.game import make_env
+    from distributed_deep_q_tpu.config import env_for_actor
+
+    games = cfg.env.games or (cfg.env.id,)
+    counts: dict[str, int] = {}
+    first = None
+    for i, g in enumerate(games):
+        e = make_env(env_for_actor(cfg.env, i), seed=cfg.train.seed)
+        if first is None:
+            first = e
+        counts[g] = e.num_actions
+    if len(set(counts.values())) != 1:
+        raise ValueError(
+            f"multi-game fleet requires one shared action space, got "
+            f"{counts}; set env.full_action_space=true for ALE games")
+    return first
+
+
 # ---------------------------------------------------------------------------
 # Actor process
 # ---------------------------------------------------------------------------
@@ -55,7 +78,9 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     from distributed_deep_q_tpu.models.qnet import QNet
     from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedClient
 
-    env = make_env(cfg.env, seed=cfg.train.seed + 1000 * (actor_id + 1))
+    from distributed_deep_q_tpu.config import env_for_actor
+    env = make_env(env_for_actor(cfg.env, actor_id),
+                   seed=cfg.train.seed + 1000 * (actor_id + 1))
     cfg.net.num_actions = env.num_actions
     qnet = QNet(cfg.net, seed=cfg.train.seed,
                 obs_dim=int(np.prod(env.obs_shape)))
@@ -372,10 +397,9 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
     from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedServer
     from distributed_deep_q_tpu.solver import Solver
-    from distributed_deep_q_tpu.train import evaluate
 
     metrics = metrics or Metrics()
-    probe = make_env(cfg.env, seed=cfg.train.seed)
+    probe = _probe_envs(cfg)
     cfg.net.num_actions = probe.num_actions
     obs_shape = probe.obs_shape
     pixel = probe.obs_dtype == np.uint8
@@ -426,7 +450,11 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     sup.start()
     sup.watch(server.last_seen)
 
-    pending = None
+    writeback = None
+    if replay.prioritized:
+        from distributed_deep_q_tpu.replay.prioritized import make_writeback
+        writeback = make_writeback(replay, cfg.replay,
+                                   lock=server.replay_lock)
     summary: dict = {}
     from distributed_deep_q_tpu.profiling import (
         StepTimer, TraceWindow, start_profiler_server)
@@ -466,7 +494,8 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                         batch = replay.sample(cfg.replay.batch_size)
                     sampled_at = batch.pop("_sampled_at")
                     with timer.phase("dispatch"):
-                        m = solver.train_step_from_ring(replay.ring, batch)
+                        m = solver.train_step_from_ring(
+                            replay.ring, batch, replay.frame_shape)
             else:
                 with timer.phase("sample"):  # wait on the staging pipeline
                     batch = stager.get()
@@ -478,12 +507,9 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
             trace.on_step(gstep)
 
             if replay.prioritized:
-                if pending is not None:
-                    with server.replay_lock:
-                        replay.update_priorities(
-                            pending[0], np.asarray(pending[1]),
-                            sampled_at=pending[2])
-                pending = (m["index"], m["td_abs"], sampled_at)
+                # pipelined write-back: the |TD| fetch never blocks the
+                # step, and the update itself takes the replay lock
+                writeback.push(m["index"], m["td_abs"], sampled_at)
 
             if gstep % cfg.actors.param_sync_period == 0:
                 server.publish_params(solver.get_weights())
@@ -511,7 +537,10 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
         server.close()
 
     summary["final_return_avg100"] = server.mean_recent_return()
-    summary["eval_return"] = evaluate(solver, cfg)
+    if writeback:
+        writeback.drain()
+    from distributed_deep_q_tpu.train import log_final_eval
+    log_final_eval(solver, cfg, metrics, summary)
     summary["env_steps"] = server.env_steps
     summary["actor_restarts"] = sup.restarts
     summary["solver"] = solver
@@ -537,7 +566,7 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
     from distributed_deep_q_tpu.utils.checkpoint import maybe_checkpointer
 
     metrics = metrics or Metrics()
-    probe = make_env(cfg.env, seed=cfg.train.seed)
+    probe = _probe_envs(cfg)
     cfg.net.num_actions = probe.num_actions
     pixel = probe.obs_dtype == np.uint8
     obs_shape = (tuple(probe.obs_shape) + (cfg.env.stack,)) if pixel \
@@ -571,7 +600,11 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
         solver.state, _ = ckpt.restore(solver.state)
         server.publish_params(solver.get_weights())
 
-    pending = None
+    writeback = None
+    if replay.prioritized:
+        from distributed_deep_q_tpu.replay.prioritized import make_writeback
+        writeback = make_writeback(replay, cfg.replay,
+                                   lock=server.replay_lock)
     summary: dict = {}
     try:
         while not replay.ready(learn_start_seqs):
@@ -584,12 +617,7 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
             metrics.count("grad_steps")
 
             if replay.prioritized:
-                if pending is not None:
-                    with server.replay_lock:
-                        replay.update_priorities(
-                            pending[0], np.asarray(pending[1]),
-                            sampled_at=pending[2])
-                pending = (m["index"], m["td_abs"], sampled_at)
+                writeback.push(m["index"], m["td_abs"], sampled_at)
 
             if gstep % cfg.actors.param_sync_period == 0:
                 server.publish_params(solver.get_weights())
@@ -611,7 +639,10 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
         server.close()
 
     summary["final_return_avg100"] = server.mean_recent_return()
-    summary["eval_return"] = evaluate_recurrent(solver, cfg)
+    if writeback:
+        writeback.drain()
+    from distributed_deep_q_tpu.train import log_final_eval
+    log_final_eval(solver, cfg, metrics, summary, recurrent=True)
     summary["env_steps"] = server.env_steps
     summary["actor_restarts"] = sup.restarts
     summary["solver"] = solver

@@ -46,6 +46,14 @@ class ReplayConfig:
     priority_beta0: float = 0.4
     priority_beta_steps: int = 1_000_000
     priority_eps: float = 1e-6
+    # PER write-back runs this many grad steps behind the learner so the
+    # per-sample |TD| D2H fetch (async-copied at dispatch) never blocks the
+    # step — see replay.prioritized.DelayedPriorityWriteback
+    priority_writeback_delay: int = 8
+    # fully device-resident PER: priorities + metadata live in HBM and
+    # sampling/priority-update fuse into the train step (zero host round
+    # trips — replay/device_per.py); needs device_resident + prioritized
+    device_per: bool = False
     n_step: int = 1
     # minimum fill before learning starts
     learn_start: int = 1_000
@@ -107,7 +115,13 @@ class TrainConfig:
 @dataclass
 class EnvConfig:
     id: str = "CartPole-v1"
-    kind: str = "gym"  # gym | atari | fake_atari
+    kind: str = "gym"  # gym | atari | fake_atari | signal_atari
+    # multi-game fleets (config 4 "Atari-57 8-game subset"): when non-empty,
+    # actor i plays games[i % len(games)] (env_for_actor) and eval reports
+    # per-game returns. All games must expose the same action count — for
+    # ALE use full_action_space=True (the 18-action set) as Ape-X does.
+    games: tuple[str, ...] = ()
+    full_action_space: bool = False
     frame_skip: int = 4
     frame_shape: tuple[int, int] = (84, 84)
     stack: int = 4
@@ -234,20 +248,40 @@ def breakout_config() -> Config:
 
 
 def apex_config() -> Config:
-    """Config 4: Ape-X style — 256 CPU actors, prioritized n-step, dueling."""
+    """Config 4: Ape-X style — 256 CPU actors, prioritized n-step, dueling,
+    8-game Atari-57 subset round-robined across the fleet (full 18-action
+    space so one Q-head serves every game)."""
     c = breakout_config()
-    c.net = dataclasses.replace(c.net, dueling=True)
+    c.net = dataclasses.replace(c.net, dueling=True, num_actions=18)
     c.actors = dataclasses.replace(c.actors, num_actors=256)
+    c.env = dataclasses.replace(
+        c.env, full_action_space=True,
+        games=("BreakoutNoFrameskip-v4", "PongNoFrameskip-v4",
+               "BeamRiderNoFrameskip-v4", "EnduroNoFrameskip-v4",
+               "QbertNoFrameskip-v4", "SeaquestNoFrameskip-v4",
+               "SpaceInvadersNoFrameskip-v4", "AsterixNoFrameskip-v4"))
     return c
 
 
 def r2d2_config() -> Config:
-    """Config 5 (stretch): R2D2 recurrent Q-net, sequence replay."""
+    """Config 5 (stretch): R2D2 recurrent Q-net, sequence replay.
+    Single-game (drops apex's multi-game round-robin): the config-5 bar is
+    the recurrent pipeline at scale, not Atari-57 coverage."""
     c = apex_config()
     c.net = dataclasses.replace(c.net, kind="r2d2", lstm_size=512)
     c.replay = dataclasses.replace(
         c.replay, sequence_length=80, burn_in=40, batch_size=64)
+    c.env = dataclasses.replace(c.env, games=(), full_action_space=False)
     return c
+
+
+def env_for_actor(env: EnvConfig, actor_id: int) -> EnvConfig:
+    """Per-actor game assignment (config 4 multi-game fleets): actor i
+    plays ``games[i % len(games)]``; single-game configs pass through."""
+    if not env.games:
+        return env
+    return dataclasses.replace(env,
+                               id=env.games[actor_id % len(env.games)])
 
 
 PRESETS = {

@@ -18,6 +18,16 @@ Shapes are the per-device view inside ``shard_map``: ``q`` is [B, A] with B
 the per-device batch. Everything fits in VMEM by construction (B ≤ a few
 hundred, A ≤ 18), so there is no grid — one program, full blocks, which is
 exactly the right schedule for a loss tail this small.
+
+MEASUREMENT: bench.py times this kernel against the XLA-fused jnp path
+every run (``pallas_on_steps_per_s`` vs ``pallas_off_steps_per_s``) so the
+claim is re-made per hardware, not asserted here — early v5e runs landed
+on both sides of parity depending on chip contention, i.e. the two paths
+are close (XLA already fuses this loss tail well; SURVEY §2.1's "Pallas
+only where XLA fusion is insufficient" holds in the sense that neither
+side is decisively faster). The kernel ships default OFF
+(``use_pallas_loss=False``) as the tested hand-written-kernel path;
+consult the current BENCH json before flipping the default.
 """
 
 from __future__ import annotations

@@ -96,7 +96,11 @@ class Learner:
         self._replicated = NamedSharding(mesh, P())
         self._batch_sharding = NamedSharding(mesh, P(AXIS_DP))
         self._train_step = self._build_train_step()
-        self._ring_step = None  # built lazily on first device-replay step
+        # ring steps built lazily, keyed on the (static) frame shape the
+        # flat HBM ring's rows decode to
+        self._ring_steps: dict[tuple[int, int], Any] = {}
+        # fused device-PER steps, keyed on the replay's static geometry
+        self._device_per_steps: dict[tuple, Any] = {}
 
     # -- state -------------------------------------------------------------
 
@@ -177,7 +181,7 @@ class Learner:
         )
         return jax.jit(sharded, donate_argnums=0)
 
-    def _build_ring_step(self):
+    def _build_ring_step(self, frame_shape: tuple[int, int]):
         """Train step fed by the device-resident frame ring: pixels are
         gathered/stacked per device from the local ring shard (indices are
         shard-local), so only [B, stack] int32 + [B] scalars cross the
@@ -187,9 +191,10 @@ class Learner:
         def step_fn(state: TrainState, ring: jax.Array,
                     batch: dict[str, jax.Array]):
             composed = {
-                "obs": compose_stacks(ring, batch["oidx"], batch["valid"]),
+                "obs": compose_stacks(ring, batch["oidx"], batch["valid"],
+                                      frame_shape),
                 "next_obs": compose_stacks(ring, batch["noidx"],
-                                           batch["nvalid"]),
+                                           batch["nvalid"], frame_shape),
                 "action": batch["action"],
                 "reward": batch["reward"],
                 "discount": batch["discount"],
@@ -207,11 +212,96 @@ class Learner:
         return jax.jit(sharded, donate_argnums=0)
 
     def train_step_from_ring(self, state: TrainState, ring: jax.Array,
-                             batch: dict[str, Any]):
+                             batch: dict[str, Any],
+                             frame_shape: tuple[int, int] = (84, 84)):
         """One DP step sampling pixels from the HBM ring (device replay)."""
-        if self._ring_step is None:
-            self._ring_step = self._build_ring_step()
-        return self._ring_step(state, ring, batch)
+        key = tuple(frame_shape)
+        if key not in self._ring_steps:
+            self._ring_steps[key] = self._build_ring_step(key)
+        return self._ring_steps[key](state, ring, batch)
+
+    def _build_device_per_step(self, spec: tuple):
+        """Fused prioritized step (replay/device_per.py): per shard —
+        validity mask → inverse-CDF prioritized draw → on-device stack +
+        n-step composition → DQN step → same-step priority scatter. The
+        host ships per-slot cursors/sizes and β; NOTHING is read back
+        (the per-sample |TD| never leaves the device)."""
+        (slot_cap, stack, n_step, gamma, frame_shape, per_shard, alpha,
+         eps, num_shards, seed) = spec
+        from distributed_deep_q_tpu.replay.device_per import (
+            DeviceReplayState, fused_sample, scatter_priorities)
+
+        S = P(AXIS_DP)
+
+        # TWO programs, not one, and NO key derivation on device. Two
+        # measured XLA:TPU pathologies shape this structure (each costs a
+        # full relayout copy of the frame ring per step — 29 ms at 1M):
+        # 1. a program where the gathered pixels flow into the CNN (or out
+        #    through a transpose) back-propagates the consumer layout onto
+        #    the ring operand;
+        # 2. a program whose sampling key comes from jax.random.fold_in
+        #    executes the ring gather ~200× slower than the same program
+        #    with the key as a plain argument (minimal pair measured:
+        #    0.05 ms vs 8.5 ms at 262k rows).
+        # So: the sample program takes per-shard keys as an argument
+        # (host-generated, ~bytes/step — the same plane that ships
+        # cursors), returns gather-natural flat stacks, and the train
+        # program does the reshape + CNN + priority scatter.
+
+        def sample_fn(keys, frames, action, reward, done, boundary, prio,
+                      cursors, sizes, beta):
+            shard_rows = {
+                "frames": frames, "action": action, "reward": reward,
+                "done": done, "boundary": boundary, "prio": prio,
+            }
+            return fused_sample(
+                keys[0], shard_rows, cursors, sizes, per_shard, slot_cap,
+                stack, n_step, gamma, beta, num_shards)
+
+        sample = jax.jit(shard_map(
+            sample_fn, mesh=self.mesh,
+            in_specs=(S, S, S, S, S, S, S, S, S, P()),
+            out_specs=({k: S for k in ("obs_rows", "nobs_rows", "action",
+                                       "reward", "discount", "weight")}, S),
+            check_vma=False))
+
+        def train_fn(state: TrainState, batch, idx, prio, maxp):
+            from distributed_deep_q_tpu.replay.device_per import (
+                stack_rows_to_obs)
+            batch = dict(batch)
+            batch["obs"] = stack_rows_to_obs(batch.pop("obs_rows"),
+                                             frame_shape)
+            batch["next_obs"] = stack_rows_to_obs(batch.pop("nobs_rows"),
+                                                  frame_shape)
+            new_state, metrics, td_abs = self._step_core(state, batch)
+            prio, maxp = scatter_priorities(prio, maxp, idx, td_abs,
+                                            alpha, eps)
+            return new_state, prio, maxp, metrics
+
+        train = jax.jit(shard_map(
+            train_fn, mesh=self.mesh,
+            in_specs=(P(), S, S, S, P()),
+            out_specs=(P(), S, P(), P()),
+            check_vma=False), donate_argnums=(0, 3, 4))
+        return sample, train
+
+    def train_step_device_per(self, state: TrainState, rows, cursors,
+                              sizes, beta: float, spec: tuple):
+        """One sample+train+priority-update step on device PER (two chained
+        XLA programs, zero host→device reads back).
+        Returns (state, new_prio, new_maxp, metrics)."""
+        if spec not in self._device_per_steps:
+            self._device_per_steps[spec] = self._build_device_per_step(spec)
+            self._sample_rng = np.random.default_rng(spec[-1])
+        sample, train = self._device_per_steps[spec]
+        d = self.mesh.shape[AXIS_DP]
+        keys = self._sample_rng.integers(0, 2**32, size=(d, 2),
+                                         dtype=np.uint32)
+        batch, idx = sample(keys, rows.frames, rows.action,
+                            rows.reward, rows.done, rows.boundary,
+                            rows.prio, np.asarray(cursors),
+                            np.asarray(sizes), np.float32(beta))
+        return train(state, batch, idx, rows.prio, rows.maxp)
 
     def train_step(self, state: TrainState, batch: dict[str, Any]):
         """One synchronous DP gradient step.

@@ -1,0 +1,454 @@
+"""Device-resident prioritized replay — sampling fused INTO the train step.
+
+The host-PER data path (``replay/prioritized.py`` sum-tree + index batches)
+pays two host round trips per grad step: the sampled-index upload and the
+per-sample |TD| readback for priority updates. On a tunneled/remote TPU
+runtime the readback alone measures ~70 ms (bench.py), and even the
+host-side sum-tree walk (~1.3 ms at batch 512 over a 1M ring) bounds the
+learner. This module moves the WHOLE prioritized loop into HBM
+(SURVEY §7.3 item 2, redesigned TPU-first instead of host-first):
+
+- per-row metadata rings (action, reward, done, boundary) and a priority
+  row ``p^α`` live on device, sharded ``P('dp')`` exactly like the frame
+  ring; the flush scatter writes all of them in one program, with fresh
+  rows initialized to the running max-priority device scalar.
+- each train step, per shard: build the validity mask from the (tiny,
+  host-shipped) per-slot cursors/sizes, draw ``B/D`` indices by inverse-CDF
+  over the masked priorities (``cumsum`` + ``searchsorted`` — the sum-tree's
+  job, done as one memory-bound pass at HBM bandwidth), compose frame
+  stacks and n-step returns from the device rings, compute IS weights
+  (stratified-realized form, matching ``DeviceFrameReplay.sample``), run
+  the DQN step, and scatter ``(|TD|+ε)^α`` straight back into the priority
+  row — zero-step-stale, no D2H anywhere.
+
+The per-device layout mirrors ``device_ring.py``: a shard holds
+``subs_per_shard`` sub-rings (slots) of ``slot_cap`` rows; all mask/window
+math reshapes ``[cap_local] → [subs, slot_cap]`` so ring wraps stay inside
+a sub-ring. Host-side slot bookkeeping (cursors/sizes/boundaries) is
+unchanged — the device copies exist so composition never needs the host.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DeviceReplayState(flax.struct.PyTreeNode):
+    """Device twin of the replay ring: pixels + metadata + priorities.
+
+    All arrays are global (mesh-sharded over their leading axis); ``maxp``
+    is the replicated running max |TD| priority (pre-α), used to seed
+    fresh rows optimistically.
+    """
+
+    frames: jax.Array     # [capacity, H·W] uint8
+    action: jax.Array     # [capacity] int32
+    reward: jax.Array     # [capacity] float32
+    done: jax.Array       # [capacity] uint8 (cuts bootstrap)
+    boundary: jax.Array   # [capacity] uint8 (any episode end)
+    prio: jax.Array       # [capacity] float32, p^α (0 = never written)
+    maxp: jax.Array       # [] float32, running max pre-α priority
+
+
+def valid_mask(done: jax.Array, boundary: jax.Array, cursors: jax.Array,
+               sizes: jax.Array, slot_cap: int, stack: int,
+               n_step: int) -> jax.Array:
+    """Per-row sampleability for one shard — device twin of
+    ``FrameStackReplay._invalid`` vectorized over the shard's sub-rings.
+
+    ``done``/``boundary`` are the shard's rows ``[cap_local]``; ``cursors``
+    and ``sizes`` are ``[subs]`` per-sub write cursors / fill counts. A row
+    is sampleable iff its ``[i-stack+1, i+n]`` window neither crosses the
+    write cursor nor falls off the filled region, and its n-step window
+    crosses no truncation-only boundary.
+    """
+    L = slot_cap
+    d = done.reshape(-1, L).astype(bool)
+    b = boundary.reshape(-1, L).astype(bool)
+    subs = d.shape[0]
+    idx = jnp.arange(L)[None, :]                        # [1, L]
+    size = sizes[:, None]                               # [subs, 1]
+    cur = cursors[:, None]
+    partial = (idx < stack - 1) | (idx + n_step >= size)
+    back = (idx - cur) % L
+    full = (back >= L - n_step) | (back < stack - 1)
+    bad = jnp.where(size < L, partial, full)
+    trunc = b & ~d
+    cross = jnp.zeros((subs, L), bool)
+    for k in range(n_step):
+        cross = cross | jnp.roll(trunc, -k, axis=1)
+    return (~(bad | cross)).reshape(-1)                 # [cap_local]
+
+
+def sample_from_cdf(key: jax.Array, prio_masked: jax.Array,
+                    num: int) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Inverse-CDF prioritized draw: ``num`` shard-local indices ∝ p.
+
+    Returns (indices [num], their probabilities p_i/mass [num], mass []).
+    One ``cumsum`` over the shard (memory-bound, HBM rate) replaces the
+    host sum-tree descent.
+    """
+    cdf = jnp.cumsum(prio_masked)
+    mass = cdf[-1]
+    u = jax.random.uniform(key, (num,)) * mass
+    idx = jnp.searchsorted(cdf, u, side="right")
+    idx = jnp.clip(idx, 0, prio_masked.shape[0] - 1)
+    p = prio_masked[idx] / jnp.maximum(mass, 1e-12)
+    return idx, p, mass
+
+
+def _stack_window(boundary: jax.Array, local: jax.Array, sub: jax.Array,
+                  slot_cap: int, stack: int) -> tuple[jax.Array, jax.Array]:
+    """(shard-local frame indices [B, stack] oldest-first, validity mask) —
+    device twin of ``FrameStackReplay._stack_indices``."""
+    L = slot_cap
+    offs = jnp.arange(stack - 1, -1, -1)                # stack-1 .. 0
+    loc = (local[:, None] - offs[None, :]) % L          # [B, stack]
+    flat = sub[:, None] * L + loc
+    prev_b = boundary[sub[:, None] * L + (loc - 1) % L].astype(bool)
+    # valid right-to-left, unrolled (stack is tiny and static): newest
+    # frame always valid, older frames valid while no boundary sits
+    # between them and the anchor
+    valid_cols = [jnp.ones(local.shape[0], bool)]
+    for j in range(stack - 2, -1, -1):
+        valid_cols.append(valid_cols[-1] & ~prev_b[:, j + 1])
+    valid = jnp.stack(valid_cols[::-1], axis=1)         # [B, stack]
+    return flat.astype(jnp.int32), valid
+
+
+def stack_rows_to_obs(rows: jax.Array,
+                      frame_shape: tuple[int, int]) -> jax.Array:
+    """[B, stack, H·W] gathered rows → [B, H, W, stack] CNN input.
+
+    Kept OUT of the sampling program on purpose: the transpose propagates
+    the consumer's preferred layout backwards onto the frame-ring gather
+    operand during XLA layout assignment, which materializes a relayout
+    copy of the ENTIRE ring per step (7 GB at 1M capacity, ~29 ms
+    measured). The sampling program returns gather-natural flat rows; the
+    train program does this (14 MB) rearrangement instead.
+    """
+    rows = rows.reshape(rows.shape[:2] + tuple(frame_shape))
+    return jnp.moveaxis(rows, 1, -1)
+
+
+def compose_from_state(state_rows: dict[str, jax.Array], local: jax.Array,
+                       sub: jax.Array, slot_cap: int, stack: int,
+                       n_step: int, gamma: float) -> dict[str, jax.Array]:
+    """Device twin of ``FrameStackReplay.gather_meta`` + frame gather: from
+    sampled (sub, local) rows build obs/next_obs stack ROWS ([B, stack,
+    H·W] — see ``stack_rows_to_obs``), n-step return and bootstrap
+    discount — entirely from the shard's device rings."""
+    L = slot_cap
+    frames, action = state_rows["frames"], state_rows["action"]
+    reward, done, boundary = (state_rows["reward"], state_rows["done"],
+                              state_rows["boundary"])
+
+    def gather_frames(flat_idx, valid):
+        f = frames[flat_idx]                            # [B, S, H·W]
+        return f * valid[..., None].astype(jnp.uint8)
+
+    oflat, ovalid = _stack_window(boundary, local, sub, L, stack)
+    nflat, nvalid = _stack_window(boundary, (local + n_step) % L, sub, L,
+                                  stack)
+    ks = jnp.arange(n_step)
+    win = sub[:, None] * L + (local[:, None] + ks[None, :]) % L  # [B, n]
+    d = done[win].astype(bool)
+    continuing = jnp.ones(d.shape, bool)
+    if n_step > 1:
+        continuing = continuing.at[:, 1:].set(
+            ~jnp.cumsum(d[:, :-1], axis=1).astype(bool))
+    gammas = gamma ** jnp.arange(n_step + 1, dtype=jnp.float32)
+    r = (reward[win] * continuing * gammas[None, :n_step]).sum(axis=1)
+    any_done = (d & continuing).any(axis=1)
+    discount = jnp.where(any_done, 0.0, gammas[n_step]).astype(jnp.float32)
+    flat = sub * L + local
+    return {
+        "obs_rows": gather_frames(oflat, ovalid),
+        "nobs_rows": gather_frames(nflat, nvalid),
+        "action": action[flat],
+        "reward": r.astype(jnp.float32),
+        "discount": discount,
+    }
+
+
+def fused_sample(key: jax.Array, shard_rows: dict[str, jax.Array],
+                 cursors: jax.Array, sizes: jax.Array, per_shard: int,
+                 slot_cap: int, stack: int, n_step: int, gamma: float,
+                 beta: jax.Array, num_shards: int,
+                 ) -> tuple[dict[str, jax.Array], jax.Array]:
+    """One shard's fused prioritized sample: mask → CDF draw → compose →
+    IS weights. Returns (batch dict incl. ``weight``, with obs as flat
+    ``*_rows`` stacks — see ``stack_rows_to_obs``; sampled shard-local
+    indices). Runs inside the learner's shard_map; ``lax.p*`` collectives
+    finish the cross-shard reductions."""
+    from jax import lax
+
+    mask = valid_mask(shard_rows["done"], shard_rows["boundary"], cursors,
+                      sizes, slot_cap, stack, n_step)
+    pm = shard_rows["prio"] * mask
+    idx, p, mass = sample_from_cdf(key, pm, per_shard)
+    sub, local = idx // slot_cap, idx % slot_cap
+    batch = compose_from_state(shard_rows, local, sub, slot_cap, stack,
+                               n_step, gamma)
+    # IS weights for the realized stratified draw: P(i) = p_i/(D·mass_s)
+    # (each shard contributes exactly per_shard draws — matches the host
+    # path's DeviceFrameReplay.sample weight math), N = global sampleable
+    # transition count.
+    n_glob = lax.psum(jnp.sum(mask.astype(jnp.float32)), "dp")
+    pr = jnp.maximum(p / num_shards, 1e-12)
+    w = (n_glob * pr) ** (-beta)
+    w_max = lax.pmax(jnp.max(w), "dp")
+    batch["weight"] = (w / w_max).astype(jnp.float32)
+    return batch, idx.astype(jnp.int32)
+
+
+def scatter_priorities(prio: jax.Array, maxp: jax.Array, idx: jax.Array,
+                       td_abs: jax.Array, alpha: float, eps: float,
+                       ) -> tuple[jax.Array, jax.Array]:
+    """Same-step priority write-back (one shard): ``p[idx] ← (|TD|+ε)^α``
+    and the running pre-α max. No staleness window exists — sampling and
+    update happen in one XLA program, so no write can interleave."""
+    from jax import lax
+
+    td = jnp.abs(td_abs) + eps
+    prio = prio.at[idx].set(td ** alpha)
+    maxp = jnp.maximum(maxp, lax.pmax(jnp.max(td), "dp"))
+    return prio, maxp
+
+
+# ---------------------------------------------------------------------------
+# The replay object: DeviceFrameReplay + device metadata/priority twin
+# ---------------------------------------------------------------------------
+
+
+class DevicePERFrameReplay:
+    """Frame ring + metadata + priorities all device-resident; sampling
+    and priority updates happen inside the fused learner step
+    (``Learner.train_step_device_per``), so per step the host ships only
+    per-slot cursors/sizes (~a few hundred bytes) and reads back nothing.
+
+    Host-side slot bookkeeping reuses ``DeviceFrameReplay``'s machinery
+    (stream→slot routing, seal-on-restart, ready gating); this class
+    mirrors every accepted row into the device rings at flush time.
+    """
+
+    prioritized = True
+
+    def __init__(self, cfg, mesh, frame_shape=(84, 84), stack: int = 4,
+                 gamma: float = 0.99, seed: int = 0, write_chunk: int = 64,
+                 num_streams: int = 1):
+        import dataclasses
+
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax import shard_map
+
+        from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
+        from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
+
+        # host trees off: priorities live on device
+        host_cfg = dataclasses.replace(cfg, prioritized=False)
+        self._base = DeviceFrameReplay(host_cfg, mesh, frame_shape, stack,
+                                       gamma, seed, write_chunk, num_streams)
+        self._cfg = cfg
+        self.mesh = mesh
+        self.stack, self.n_step, self.gamma = int(stack), cfg.n_step, gamma
+        self.frame_shape = tuple(frame_shape)
+        self._samples = 0
+
+        b = self._base
+        sharded = NamedSharding(mesh, P(AXIS_DP))
+        replicated = NamedSharding(mesh, P())
+        cap = b.capacity
+
+        # metadata/priority rings allocated directly on the mesh; the frame
+        # ring is ADOPTED from the base (NOT closed over in a jit — a
+        # captured 7 GB device array would be lowered as a constant)
+        def init_meta():
+            return (jnp.zeros(cap, jnp.int32), jnp.zeros(cap, jnp.float32),
+                    jnp.zeros(cap, jnp.uint8), jnp.zeros(cap, jnp.uint8),
+                    jnp.zeros(cap, jnp.float32), jnp.ones((), jnp.float32))
+
+        action, reward, done, boundary, prio, maxp = jax.jit(
+            init_meta, out_shardings=(sharded, sharded, sharded, sharded,
+                                      sharded, replicated))()
+        self.dstate = DeviceReplayState(
+            frames=b.ring, action=action, reward=reward, done=done,
+            boundary=boundary, prio=prio, maxp=maxp)
+        b.ring = None  # the frames now live in dstate (single owner)
+
+        # Widen the base's staging pipeline with the metadata columns and
+        # route its write dispatch to the full-state scatter: the generic
+        # drain in DeviceFrameReplay.flush then serves both classes (no
+        # duplicated FIFO logic, no per-row Python on the ingest path).
+        b._stage_columns = b._stage_columns + [
+            ((), np.int32), ((), np.float32), ((), np.uint8), ((), np.uint8)]
+
+        def stage_with_meta(slot, local, frames_arr):
+            m = b.slots[slot]
+            shard, base_off = b._slot_base(slot)
+            b._pending[shard].append((
+                (base_off + local).astype(np.int32), frames_arr,
+                m.action[local], m.reward[local],
+                m.done[local].astype(np.uint8),
+                m.boundary[local].astype(np.uint8)))
+            b._pending_rows[shard] += len(local)
+
+        b._stage = stage_with_meta
+
+        def apply_write_full(idx, cols):
+            self.dstate = self._write_full(self.dstate, idx, *cols)
+
+        b._apply_write = apply_write_full
+
+        # boundary-only scatter for reset_stream: the device boundary ring
+        # must mirror the host seal or the fused sampler would compose
+        # windows across a dead writer's seam (frames can't be re-written
+        # here — they aren't stored host-side — so this touches ONE column)
+        def seal(boundary, idx):
+            return boundary.at[idx].set(1, mode="drop")
+
+        self._seal_writer = jax.jit(
+            shard_map(seal, mesh=mesh,
+                      in_specs=(P(AXIS_DP), P(AXIS_DP)),
+                      out_specs=P(AXIS_DP), check_vma=False),
+            donate_argnums=0)
+
+        alpha = float(cfg.priority_alpha)
+
+        def write(rows, idx, frames, action, reward, done, boundary):
+            new_p = rows.maxp ** alpha
+            return DeviceReplayState(
+                frames=rows.frames.at[idx].set(frames, mode="drop"),
+                action=rows.action.at[idx].set(action, mode="drop"),
+                reward=rows.reward.at[idx].set(reward, mode="drop"),
+                done=rows.done.at[idx].set(done, mode="drop"),
+                boundary=rows.boundary.at[idx].set(boundary, mode="drop"),
+                prio=rows.prio.at[idx].set(new_p, mode="drop"),
+                maxp=rows.maxp,
+            )
+
+        P_ = P
+        state_spec = DeviceReplayState(
+            frames=P_(AXIS_DP), action=P_(AXIS_DP), reward=P_(AXIS_DP),
+            done=P_(AXIS_DP), boundary=P_(AXIS_DP), prio=P_(AXIS_DP),
+            maxp=P_())
+        # entry/exit layouts pinned to the live arrays' formats: XLA's
+        # auto layout assignment may otherwise pick a transposed entry
+        # layout for the frame ring and relayout-copy the whole thing
+        # every flush (see Learner.train_step_device_per)
+        state_fmt = jax.tree.map(lambda x: x.format, self.dstate)
+        self._write_full = jax.jit(
+            shard_map(write, mesh=mesh,
+                      in_specs=(state_spec, P_(AXIS_DP), P_(AXIS_DP),
+                                P_(AXIS_DP), P_(AXIS_DP), P_(AXIS_DP),
+                                P_(AXIS_DP)),
+                      out_specs=state_spec,
+                      check_vma=False),
+            in_shardings=(state_fmt, None, None, None, None, None, None),
+            out_shardings=state_fmt,
+            donate_argnums=0)
+
+    # -- delegated host bookkeeping -----------------------------------------
+
+    def __len__(self):
+        return len(self._base)
+
+    @property
+    def steps_added(self):
+        return self._base.steps_added
+
+    @property
+    def capacity(self):
+        return self._base.capacity
+
+    @property
+    def num_shards(self):
+        return self._base.num_shards
+
+    @property
+    def slot_cap(self):
+        return self._base.slot_cap
+
+    @property
+    def subs_per_shard(self):
+        return self._base.subs_per_shard
+
+    @property
+    def slots(self):
+        return self._base.slots
+
+    def ready(self, learn_start: int) -> bool:
+        return self._base.ready(learn_start)
+
+    def reset_stream(self, stream: int) -> None:
+        """Seal the stream's current slot on HOST AND DEVICE: the fused
+        sampler reads the device boundary ring, so a host-only seal would
+        let sampled windows straddle the dead writer's seam."""
+        b = self._base
+        if not (0 <= stream < b.num_streams):
+            return
+        # flush FIRST: rows still staged carry their pre-seal boundary
+        # values and a later flush would scatter them over the seal
+        self.flush()
+        cycle = b._slot_cycle[stream]
+        slot = cycle[b._stream_pos[stream] % len(cycle)]
+        m = b.slots[slot]
+        b.reset_stream(stream)
+        if len(m) == 0:
+            return
+        local = (m._cursor - 1) % b.slot_cap
+        shard, base_off = b._slot_base(slot)
+        # one lane per shard; non-owners carry an OOB index the scatter drops
+        idx = np.full(b.num_shards, b.cap_local, np.int32)
+        idx[shard] = base_off + local
+        self.dstate = self.dstate.replace(
+            boundary=self._seal_writer(self.dstate.boundary, idx))
+
+    @property
+    def beta(self):
+        from distributed_deep_q_tpu.replay.prioritized import beta_at
+        return beta_at(self._samples, self._cfg.priority_beta0,
+                       self._cfg.priority_beta_steps)
+
+    def count_sample(self) -> None:
+        """β anneal is denominated in learner samples (= fused steps)."""
+        self._samples += 1
+
+    # -- write path (base machinery, widened at __init__) -------------------
+
+    def add(self, frame, action, reward, done, boundary=None) -> int:
+        return self._base.add(frame, action, reward, done, boundary)
+
+    def add_batch(self, batch, stream: int = 0):
+        return self._base.add_batch(batch, stream=stream)
+
+    def flush(self) -> None:
+        """Drain staged rows through the base's generic chunked flush; the
+        patched ``_apply_write`` routes each padded chunk (frames +
+        metadata columns) to the full-state scatter, which also seeds the
+        fresh rows' priorities from the device max-priority scalar."""
+        self._base.flush()
+
+    # -- learner-side inputs -------------------------------------------------
+
+    def device_inputs(self):
+        """(cursors, sizes) int32 host arrays, shard-major ``[D·subs]`` so
+        ``P('dp')`` hands each device its own sub-rings' state."""
+        import numpy as np
+
+        b = self._base
+        d, subs = b.num_shards, b.subs_per_shard
+        cursors = np.zeros(d * subs, np.int32)
+        sizes = np.zeros(d * subs, np.int32)
+        for g in range(b.num_slots):
+            s, sub = g % d, g // d
+            m = b.slots[g]
+            cursors[s * subs + sub] = m._cursor
+            sizes[s * subs + sub] = len(m)
+        return cursors, sizes

@@ -6,10 +6,12 @@ minibatches host→device every step (Caffe blob loads, SURVEY §3.1); a
 pmap-fed rebuild doing the same ships ~29 MB/step at batch 512 — measured
 at ~160 ms over this container's TPU link vs a 0.2 ms train step. Instead:
 
-- **Frames enter HBM once, at actor rate.** A uint8 ring ``[capacity, H, W]``
-  lives on the learner mesh, sharded over the ``dp`` axis (each device owns
-  a contiguous shard — Ape-X-style per-learner replay shards). Writers
-  append in fixed-size chunks through a donated ``shard_map`` scatter.
+- **Frames enter HBM once, at actor rate.** A uint8 ring ``[capacity, H·W]``
+  (frames flattened row-wise — TPU tiling-aware layout, see
+  ``compose_stacks``) lives on the learner mesh, sharded over the ``dp``
+  axis (each device owns a contiguous shard — Ape-X-style per-learner
+  replay shards). Writers append in fixed-size chunks through a donated
+  ``shard_map`` scatter.
 - **The train step gathers on device.** The host samples *indices* (uniform
   or PER sum-tree — pointer-chasing stays on host, SURVEY §7.3 item 2),
   composes n-step returns/validity masks from metadata, and ships only
@@ -51,16 +53,25 @@ from distributed_deep_q_tpu.replay.prioritized import (
 from distributed_deep_q_tpu.replay.replay_memory import FrameStackReplay
 
 
-def compose_stacks(ring: jax.Array, oidx: jax.Array,
-                   valid: jax.Array) -> jax.Array:
-    """[capL, H, W] ring + [B, stack] indices/mask → [B, H, W, stack] uint8.
+def compose_stacks(ring: jax.Array, oidx: jax.Array, valid: jax.Array,
+                   frame_shape: tuple[int, int] = (84, 84)) -> jax.Array:
+    """[capL, H·W] ring + [B, stack] indices/mask → [B, H, W, stack] uint8.
 
     Pure jax; runs per-device inside the learner's shard_map (indices are
     shard-local). Invalid frames (preceding episode start) zero out, matching
     ``FrameStackReplay.gather`` / ``FrameStacker.reset`` semantics.
+
+    The ring stores frames FLATTENED to one [H·W] row per frame: TPU tiles
+    the two minor dims of an array ((32, 128) lanes for 8-bit types), so a
+    [cap, 84, 84] ring pads each frame to 96×128 — 1.74× HBM waste that
+    OOMs a 16 GB chip at the config-2 1M-frame capacity. Flattened, the
+    pad is 7056→7168 (1.6%) and the full 1M ring fits a single v5e with
+    room for the step. The gather is row-wise either way; only the final
+    reshape (free, layout-compatible) differs.
     """
-    frames = ring[oidx]                                   # [B, S, H, W]
-    frames = frames * valid[..., None, None].astype(jnp.uint8)
+    frames = ring[oidx]                                   # [B, S, H·W]
+    frames = frames * valid[..., None].astype(jnp.uint8)
+    frames = frames.reshape(frames.shape[:2] + tuple(frame_shape))
     return jnp.moveaxis(frames, 1, -1)                    # [B, H, W, S]
 
 
@@ -125,8 +136,11 @@ class DeviceFrameReplay:
         self._stream_pos = [0] * self.num_streams
 
         # HBM ring, allocated directly with its dp sharding (no host copy).
+        # Frames are flattened to [H·W] rows — see compose_stacks for why
+        # (TPU (32,128) tiling of the minor dims).
         ring_sharding = NamedSharding(mesh, P(AXIS_DP))
-        shape = (self.capacity,) + self.frame_shape
+        self._row_len = int(np.prod(self.frame_shape))
+        shape = (self.capacity, self._row_len)
         self.ring = jax.jit(
             lambda: jnp.zeros(shape, jnp.uint8),
             out_shardings=ring_sharding)()
@@ -142,9 +156,15 @@ class DeviceFrameReplay:
                       out_specs=P(AXIS_DP)),
             donate_argnums=0)
 
-        # host staging: per-shard pending (in-shard offset, frame)
-        self._pending: list[list[tuple[int, np.ndarray]]] = [
-            [] for _ in range(d)]
+        # host staging: per-shard FIFO of (in-shard offsets [n], *columns)
+        # array chunks — array-granular so actor-rate ingest costs
+        # O(segments), not O(rows), of Python (the ReplayFeed hot path).
+        # _stage_columns describes the columns' (tail shape, dtype);
+        # subclasses (device_per) extend it with metadata columns.
+        self._stage_columns: list[tuple[tuple[int, ...], type]] = [
+            ((self._row_len,), np.uint8)]
+        self._pending: list[list[tuple]] = [[] for _ in range(d)]
+        self._pending_rows = [0] * d
 
     # -- layout helpers -----------------------------------------------------
 
@@ -197,46 +217,71 @@ class DeviceFrameReplay:
 
     # -- write path ---------------------------------------------------------
 
-    def _add_row(self, stream: int, frame, action, reward, done,
-                 boundary) -> int:
-        cycle = self._slot_cycle[stream]
-        slot = cycle[self._stream_pos[stream] % len(cycle)]
-        i = self.slots[slot].add(None, action, reward, done, boundary=boundary)
+    def _stage(self, slot: int, local: np.ndarray, frames: np.ndarray) -> None:
+        """Queue (slot-local rows, flat frames) for the HBM scatter and set
+        their fresh-row priorities."""
         if self.prioritized:
             self.trees[slot].set(
-                np.asarray([i]),
-                np.asarray([self.max_priority ** self._cfg.priority_alpha]))
+                local, np.full(len(local),
+                               self.max_priority ** self._cfg.priority_alpha))
         shard, base = self._slot_base(slot)
-        self._pending[shard].append((base + i, np.asarray(frame, np.uint8)))
-        over = done if boundary is None else boundary
-        if over:
-            # episode finished → move this stream to its next slot, so one
-            # stream eventually reaches every shard it owns
-            self._stream_pos[stream] += 1
-        return self._global_index(slot, np.asarray(i))
+        self._pending[shard].append(
+            ((base + local).astype(np.int32), frames))
+        self._pending_rows[shard] += len(local)
 
     def add(self, frame, action, reward, done, boundary=None) -> int:
         """Single-stream add (in-process training loop)."""
-        idx = self._add_row(0, frame, action, reward, done, boundary)
-        if max(len(p) for p in self._pending) >= self.write_chunk:
+        cycle = self._slot_cycle[0]
+        slot = cycle[self._stream_pos[0] % len(cycle)]
+        i = self.slots[slot].add(None, action, reward, done, boundary=boundary)
+        self._stage(slot, np.asarray([i]),
+                    np.asarray(frame, np.uint8).reshape(1, -1))
+        if done if boundary is None else boundary:
+            # episode finished → move this stream to its next slot, so one
+            # stream eventually reaches every shard it owns
+            self._stream_pos[0] += 1
+        if max(self._pending_rows) >= self.write_chunk:
             self.flush()
-        return int(idx)
+        return int(self._global_index(slot, np.asarray(i)))
 
     def add_batch(self, batch, stream: int = 0) -> np.ndarray:
         """Contiguous chunk from one actor stream (RPC path). The chunk may
         contain episode boundaries; rows route to the stream's current slot,
-        which advances at each boundary."""
+        which advances at each boundary — so the chunk splits into
+        boundary-delimited segments, each inserted with ONE vectorized
+        metadata add + ONE priority-tree set + ONE staged frame block
+        (per-row Python here was the measured config-4 ingest ceiling)."""
         assert 0 <= stream < self.num_streams, \
             f"stream {stream} outside configured num_streams={self.num_streams}"
         n = len(batch["action"])
         done = np.asarray(batch["done"], bool)
         boundary = np.asarray(batch.get("boundary", batch["done"]), bool)
+        frames = np.ascontiguousarray(
+            np.asarray(batch["frame"], np.uint8).reshape(n, -1))
+        action = np.asarray(batch["action"])
+        reward = np.asarray(batch["reward"])
         out = np.empty(n, np.int64)
-        for r in range(n):
-            out[r] = self._add_row(
-                stream, batch["frame"][r], batch["action"][r],
-                batch["reward"][r], bool(done[r]), bool(boundary[r]))
-        if max(len(p) for p in self._pending) >= self.write_chunk:
+        cuts = np.flatnonzero(boundary) + 1  # segment ends (exclusive)
+        if len(cuts) == 0 or cuts[-1] != n:
+            cuts = np.append(cuts, n)
+        s0 = 0
+        for s1 in cuts:
+            cycle = self._slot_cycle[stream]
+            slot = cycle[self._stream_pos[stream] % len(cycle)]
+            m = self.slots[slot]
+            # cap one metadata insert at slot_cap rows so a single call can
+            # never wrap its own sub-ring (duplicate offsets in one scatter)
+            for p0 in range(s0, s1, self.slot_cap):
+                p1 = min(p0 + self.slot_cap, s1)
+                li = m.add_batch({
+                    "action": action[p0:p1], "reward": reward[p0:p1],
+                    "done": done[p0:p1], "boundary": boundary[p0:p1]})
+                self._stage(slot, li, frames[p0:p1])
+                out[p0:p1] = self._global_index(slot, li)
+            if boundary[s1 - 1]:
+                self._stream_pos[stream] += 1
+            s0 = s1
+        if max(self._pending_rows) >= self.write_chunk:
             self.flush()
         return out
 
@@ -259,18 +304,37 @@ class DeviceFrameReplay:
         program); shards with fewer pending frames pad with out-of-bounds
         indices that the scatter drops.
         """
-        while any(self._pending):
+        while any(self._pending_rows):
             k, d = self.write_chunk, self.num_shards
             idx = np.full((d, k), self.cap_local, np.int32)  # OOB = dropped
-            frames = np.zeros((d, k) + self.frame_shape, np.uint8)
+            cols = [np.zeros((d, k) + tail, dt)
+                    for tail, dt in self._stage_columns]
             for s in range(d):
-                take, self._pending[s] = (self._pending[s][:k],
-                                          self._pending[s][k:])
-                for j, (i, f) in enumerate(take):
-                    idx[s, j], frames[s, j] = i, f
-            self.ring = self._write(
-                self.ring, idx.reshape(d * k),
-                frames.reshape((d * k,) + self.frame_shape))
+                fill = 0
+                while self._pending[s] and fill < k:
+                    entry = self._pending[s][0]
+                    i_arr = entry[0]
+                    take = min(len(i_arr), k - fill)
+                    idx[s, fill:fill + take] = i_arr[:take]
+                    for col, arr in zip(cols, entry[1:]):
+                        col[s, fill:fill + take] = arr[:take]
+                    fill += take
+                    self._pending_rows[s] -= take
+                    if take == len(i_arr):
+                        self._pending[s].pop(0)
+                    else:  # split the chunk, preserving FIFO write order
+                        self._pending[s][0] = tuple(
+                            a[take:] for a in entry)
+            self._apply_write(
+                idx.reshape(d * k),
+                [c.reshape((d * k,) + t) for c, (t, _) in
+                 zip(cols, self._stage_columns)])
+
+    def _apply_write(self, idx: np.ndarray, cols: list) -> None:
+        """Dispatch one padded write chunk to the device ring. Subclasses
+        with extra staged columns (device_per) override this to feed their
+        wider scatter program."""
+        self.ring = self._write(self.ring, idx, cols[0])
 
     # -- sample path --------------------------------------------------------
 

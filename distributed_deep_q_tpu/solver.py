@@ -85,17 +85,44 @@ class Solver:
             out["index"] = batch["index"]
         return out
 
-    def train_step_from_ring(self, ring, batch: dict[str, Any]) -> dict[str, Any]:
+    def train_step_from_ring(self, ring, batch: dict[str, Any],
+                             frame_shape: tuple[int, int] | None = None,
+                             ) -> dict[str, Any]:
         """One gradient step sampling pixels from the device-resident replay
         ring (``replay/device_ring.py``): ``batch`` carries only indices,
-        masks, and scalars — frames are gathered in HBM inside the step."""
+        masks, and scalars — frames are gathered in HBM inside the step.
+        ``frame_shape`` decodes the ring's flat rows (pass the replay's own
+        ``frame_shape``; defaults to the net config's)."""
         self.state, metrics, td_abs = self.learner.train_step_from_ring(
-            self.state, ring, _strip_host_keys(batch))
+            self.state, ring, _strip_host_keys(batch),
+            frame_shape=tuple(frame_shape or self.config.net.frame_shape))
         out: dict[str, Any] = dict(metrics)
         out["td_abs"] = td_abs
         if "index" in batch:
             out["index"] = batch["index"]
         return out
+
+    def train_step_device_per(self, replay) -> dict[str, Any]:
+        """One FUSED prioritized step on a ``DevicePERFrameReplay``:
+        sampling, composition, the gradient step, and the priority update
+        are one XLA program; the host ships ~bytes of cursors and reads
+        back nothing (replay/device_per.py)."""
+        replay.flush()  # device rows must cover everything the host
+        # bookkeeping (cursors/sizes below) claims is written
+        cursors, sizes = replay.device_inputs()
+        beta = replay.beta
+        replay.count_sample()
+        spec = (replay.slot_cap, replay.stack, replay.n_step, replay.gamma,
+                tuple(replay.frame_shape),
+                self.config.replay.batch_size // replay.num_shards,
+                float(self.config.replay.priority_alpha),
+                float(self.config.replay.priority_eps),
+                replay.num_shards, self.config.train.seed)
+        self.state, prio, maxp, metrics = \
+            self.learner.train_step_device_per(
+                self.state, replay.dstate, cursors, sizes, beta, spec)
+        replay.dstate = replay.dstate.replace(prio=prio, maxp=maxp)
+        return dict(metrics)
 
     # -- inference (actor path) -------------------------------------------
 

@@ -53,6 +53,35 @@ def evaluate(solver: Solver, cfg: Config, episodes: int | None = None,
     return float(np.mean(returns))
 
 
+def evaluate_per_game(solver, cfg: Config, episodes: int | None = None,
+                      seed: int = 10_000, recurrent: bool = False,
+                      ) -> dict[str, float]:
+    """Greedy eval on every configured game (config 4 multi-game fleets):
+    ``{game_id: mean return}``; single-game configs return one entry."""
+    import dataclasses
+
+    fn = evaluate_recurrent if recurrent else evaluate
+    out = {}
+    for g in (cfg.env.games or (cfg.env.id,)):
+        gcfg = cfg.replace(env=dataclasses.replace(cfg.env, id=g))
+        out[g] = fn(solver, gcfg, episodes, seed)
+    return out
+
+
+def log_final_eval(solver, cfg: Config, metrics: Metrics, summary: dict,
+                   recurrent: bool = False) -> float:
+    """Final greedy eval across all configured games: fills ``summary``
+    (``eval_return`` mean, ``eval_per_game`` when multi-game) and logs
+    per-game metrics. Shared by the distributed loops."""
+    per_game = evaluate_per_game(solver, cfg, recurrent=recurrent)
+    summary["eval_return"] = float(np.mean(list(per_game.values())))
+    if len(per_game) > 1:
+        summary["eval_per_game"] = per_game
+        metrics.log(cfg.train.total_steps,
+                    **{f"eval_return/{g}": v for g, v in per_game.items()})
+    return summary["eval_return"]
+
+
 def train_single_process(cfg: Config, metrics: Metrics | None = None,
                          log_every: int = 1_000) -> dict:
     """Run config-1-style training; returns final summary metrics.
@@ -101,11 +130,21 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
                     "multi-host pixel runs need replay.device_resident=false")
             # TPU-first data path: frames live in HBM, the step gathers
             # stacks on device; PER (when enabled) is handled per shard
-            # inside DeviceFrameReplay
-            replay = DeviceFrameReplay(
-                cfg.replay, solver.mesh, env.obs_shape, cfg.env.stack,
-                cfg.train.gamma, seed=seed,
-                write_chunk=cfg.replay.write_chunk)
+            # inside DeviceFrameReplay — or fully fused into the step
+            # (device_per: priorities/metadata in HBM, zero host round
+            # trips per step)
+            if cfg.replay.prioritized and cfg.replay.device_per:
+                from distributed_deep_q_tpu.replay.device_per import (
+                    DevicePERFrameReplay)
+                replay = DevicePERFrameReplay(
+                    cfg.replay, solver.mesh, env.obs_shape, cfg.env.stack,
+                    cfg.train.gamma, seed=seed,
+                    write_chunk=cfg.replay.write_chunk)
+            else:
+                replay = DeviceFrameReplay(
+                    cfg.replay, solver.mesh, env.obs_shape, cfg.env.stack,
+                    cfg.train.gamma, seed=seed,
+                    write_chunk=cfg.replay.write_chunk)
         else:
             replay = maybe_prioritize(FrameStackReplay(
                 cfg.replay.capacity, env.obs_shape, cfg.env.stack,
@@ -122,7 +161,13 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
     obs = stacker.reset(frame) if pixel_env else frame
     ep_ret, ep_returns = 0.0, MovingAverage(100)
     summary: dict = {}
-    pending = None  # (index, td_abs, sampled_at) awaiting PER write-back
+    from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
+    fused_per = isinstance(replay, DevicePERFrameReplay)
+    writeback = None
+    if replay.prioritized and not fused_per:
+        from distributed_deep_q_tpu.replay.prioritized import make_writeback
+        writeback = make_writeback(replay, cfg.replay,
+                                   to_host=None if pc == 1 else local_rows)
     learn_live = False  # latched once warm (all shards warm, multi-host)
     gsteps = 0
     best_eval, best_params = float("-inf"), None
@@ -180,29 +225,31 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
             if learn_live and t % cfg.train.train_every == 0:
                 # learn phase: j minibatches per k env steps (SURVEY §3.1 [M])
                 for _ in range(cfg.train.grad_steps_per_train):
-                    with timer.phase("sample"):
-                        batch = replay.sample(local_batch)
-                    sampled_at = batch.pop("_sampled_at", replay.steps_added)
-                    with timer.phase("dispatch"):
-                        if isinstance(replay, DeviceFrameReplay):
-                            m = solver.train_step_from_ring(replay.ring, batch)
-                        else:
-                            m = solver.train_step(batch)
+                    if fused_per:
+                        # sample+train+priority-update fused on device
+                        with timer.phase("dispatch"):
+                            m = solver.train_step_device_per(replay)
+                    else:
+                        with timer.phase("sample"):
+                            batch = replay.sample(local_batch)
+                        sampled_at = batch.pop("_sampled_at",
+                                               replay.steps_added)
+                        with timer.phase("dispatch"):
+                            if isinstance(replay, DeviceFrameReplay):
+                                m = solver.train_step_from_ring(
+                                    replay.ring, batch, replay.frame_shape)
+                            else:
+                                m = solver.train_step(batch)
                     gsteps += 1
                     timer.step_done()
                     trace.on_step(gsteps)
-                    if replay.prioritized:
-                        # one-step-delayed priority write-back: materializing
-                        # |TD| for the *previous* step is free by now (its
-                        # device work is done), so the fresh step is never
-                        # host-blocked. Multi-host: each process writes back
-                        # only its own rows, into its own shard.
-                        if pending is not None:
-                            td = (np.asarray(pending[1]) if pc == 1
-                                  else local_rows(pending[1]))
-                            replay.update_priorities(pending[0], td,
-                                                     sampled_at=pending[2])
-                        pending = (m["index"], m["td_abs"], sampled_at)
+                    if replay.prioritized and not fused_per:
+                        # pipelined priority write-back: |TD| is async-
+                        # copied at dispatch and consumed ``depth`` steps
+                        # later, so the learner never blocks on a D2H
+                        # fetch. Multi-host: each process writes back only
+                        # its own rows, into its own shard (local_rows).
+                        writeback.push(m["index"], m["td_abs"], sampled_at)
                     metrics.count("grad_steps")
                     if ckpt and gsteps % cfg.train.checkpoint_every == 0:
                         ckpt.save(solver.state, extra={"env_steps": t})
@@ -228,6 +275,8 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
 
     finally:
         trace.close()
+    if writeback:
+        writeback.drain()  # apply the depth-queued priority tail
     summary["final_return_avg100"] = ep_returns.value
     final_ret = evaluate(solver, cfg)
     if best_params is not None and best_eval > final_ret:
@@ -312,7 +361,10 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
     carry = solver.initial_state(1)
     ep_ret, ep_returns = 0.0, MovingAverage(100)
     summary: dict = {}
-    pending = None
+    writeback = None
+    if replay.prioritized:
+        from distributed_deep_q_tpu.replay.prioritized import make_writeback
+        writeback = make_writeback(replay, cfg.replay)
     gsteps = 0
     ckpt = maybe_checkpointer(cfg.train)
     if ckpt and cfg.train.resume and ckpt.latest_step() is not None:
@@ -353,11 +405,7 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
             m = solver.train_step(batch)
             gsteps += 1
             if replay.prioritized:
-                if pending is not None:
-                    replay.update_priorities(pending[0],
-                                             np.asarray(pending[1]),
-                                             sampled_at=pending[2])
-                pending = (m["index"], m["td_abs"], sampled_at)
+                writeback.push(m["index"], m["td_abs"], sampled_at)
             metrics.count("grad_steps")
             if ckpt and gsteps % cfg.train.checkpoint_every == 0:
                 ckpt.save(solver.state, extra={"env_steps": t})
@@ -370,6 +418,8 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
                 }
                 metrics.log(gsteps, **summary)
 
+    if writeback:
+        writeback.drain()
     if ckpt:
         ckpt.save(solver.state, extra={"env_steps": cfg.train.total_steps},
                   wait=True)

@@ -52,12 +52,15 @@ def test_device_batch_matches_host_gather_dp1():
 
     # the device composition must be byte-identical to the host replay's
     # gather for the same indices
+    import functools
+
     import jax
     idx = batch["index"].astype(np.int64)
     ref = host.gather(idx)
-    obs_dev = np.asarray(jax.jit(compose_stacks)(
+    compose = functools.partial(compose_stacks, frame_shape=(8, 8))
+    obs_dev = np.asarray(jax.jit(compose)(
         dev.ring, batch["oidx"], batch["valid"]))
-    nobs_dev = np.asarray(jax.jit(compose_stacks)(
+    nobs_dev = np.asarray(jax.jit(compose)(
         dev.ring, batch["noidx"], batch["nvalid"]))
     np.testing.assert_array_equal(obs_dev, ref["obs"])
     np.testing.assert_array_equal(nobs_dev, ref["next_obs"])
@@ -70,6 +73,8 @@ def test_device_batch_shard_locality_dp8():
     learner does, and check each device's rows against pixels from its OWN
     ring shard and metadata from its OWN shard buffer — catches shard
     mis-ordering or layout drift that a global-gather comparison cannot."""
+    import functools
+
     import jax
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -85,12 +90,12 @@ def test_device_batch_shard_locality_dp8():
     batch.pop("_sampled_at")
 
     sharded = jax.jit(shard_map(
-        compose_stacks, mesh=mesh,
+        functools.partial(compose_stacks, frame_shape=(8, 8)), mesh=mesh,
         in_specs=(P("dp"), P("dp"), P("dp")), out_specs=P("dp"),
         check_vma=False))
     obs_dev = np.asarray(sharded(dev.ring, batch["oidx"], batch["valid"]))
 
-    ring = np.asarray(dev.ring)
+    ring = np.asarray(dev.ring).reshape(-1, 8, 8)
     cap_l = dev.cap_local
     for s in range(dp):
         rows = slice(s * per, (s + 1) * per)
@@ -119,7 +124,7 @@ def test_ring_contents_match_stream_dp1():
         frames.append(f)
         dev.add(f, 0, 0.0, done=(i % 10 == 9))
     dev.flush()
-    ring = np.asarray(dev.ring)
+    ring = np.asarray(dev.ring).reshape(-1, 4, 4)
     for i, f in enumerate(frames):
         np.testing.assert_array_equal(ring[i], f)
 
@@ -132,7 +137,7 @@ def test_ring_wraparound_overwrites():
         dev.add(np.full((4, 4), i % 256, np.uint8), 0, 0.0,
                 done=(i % 6 == 5))
     dev.flush()
-    ring = np.asarray(dev.ring)
+    ring = np.asarray(dev.ring).reshape(-1, 4, 4)
     # slots 0..7 hold frames 16..23; slots 8..15 still hold 8..15
     for slot in range(8):
         np.testing.assert_array_equal(ring[slot], np.full((4, 4), 16 + slot))
@@ -215,7 +220,7 @@ def test_multi_stream_subrings_no_interleave():
                 "done": np.asarray([i == n - 1 for i in range(n)]),
             }, stream=stream)
     dev.flush()
-    ring = np.asarray(dev.ring)
+    ring = np.asarray(dev.ring).reshape(-1, 4, 4)
     # every slot's metadata holds exactly one stream's actions, and its ring
     # region holds only that stream's frame tags
     for g in range(4):
