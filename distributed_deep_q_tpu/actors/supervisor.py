@@ -420,8 +420,14 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
             "(train --set mesh.num_processes=N, no --distributed) — each "
             "host's env feeds its own replay shard and the train step's "
             "pmean spans hosts (SURVEY §5.8)")
+    from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
     if pixel and cfg.replay.device_resident:
-        replay = DeviceFrameReplay(
+        # fused device PER (prioritized + device_per): the learner step
+        # samples/updates in HBM, so the lock below covers flush + dispatch
+        cls = (DevicePERFrameReplay
+               if cfg.replay.prioritized and cfg.replay.device_per
+               else DeviceFrameReplay)
+        replay = cls(
             replay_cfg, solver.mesh, obs_shape, cfg.env.stack,
             cfg.train.gamma, seed=cfg.train.seed,
             write_chunk=cfg.replay.write_chunk,
@@ -450,8 +456,9 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     sup.start()
     sup.watch(server.last_seen)
 
+    fused_per = isinstance(replay, DevicePERFrameReplay)
     writeback = None
-    if replay.prioritized:
+    if replay.prioritized and not fused_per:
         from distributed_deep_q_tpu.replay.prioritized import make_writeback
         writeback = make_writeback(replay, cfg.replay,
                                    lock=server.replay_lock)
@@ -473,7 +480,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
         # wait for warm-up fill (actors are streaming meanwhile)
         while not replay.ready(cfg.replay.learn_start):
             time.sleep(0.05)
-        if not isinstance(replay, DeviceFrameReplay):
+        if not (isinstance(replay, DeviceFrameReplay) or fused_per):
             # host-batch path: double-buffered sample → device_put pipeline
             # (SURVEY §7.3 item 1); shares the server's replay lock so the
             # background sampler serializes with RPC writers and with PER
@@ -484,7 +491,14 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                 sharding=solver.learner._batch_sharding, depth=2,
                 lock=server.replay_lock)
         for gstep in range(1, cfg.train.total_steps + 1):
-            if isinstance(replay, DeviceFrameReplay):
+            if fused_per:
+                # the fused step flushes staged actor rows + dispatches in
+                # one go; the lock serializes against RPC writers so the
+                # donated device state can't be swapped mid-dispatch
+                with server.replay_lock:
+                    with timer.phase("dispatch"):
+                        m = solver.train_step_device_per(replay)
+            elif isinstance(replay, DeviceFrameReplay):
                 # sample AND dispatch under the lock: a concurrent actor
                 # flush donates the current ring buffer, so the step must be
                 # enqueued before the ring handle can be invalidated
@@ -506,7 +520,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
             timer.step_done()
             trace.on_step(gstep)
 
-            if replay.prioritized:
+            if replay.prioritized and not fused_per:
                 # pipelined write-back: the |TD| fetch never blocks the
                 # step, and the update itself takes the replay lock
                 writeback.push(m["index"], m["td_abs"], sampled_at)

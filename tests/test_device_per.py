@@ -133,7 +133,39 @@ def test_fused_step_end_to_end_smoke():
     summary = train_single_process(cfg, log_every=10)
     assert np.isfinite(summary["loss"])
     assert summary["solver"].step == pytest.approx(25, abs=1)
-    # the step's scatter actually moved priorities off the fresh-row value
+
+
+def test_fused_step_updates_priorities():
+    """The fused step's scatter must move sampled rows' priorities off the
+    fresh-row max-priority seed (and track the running max)."""
+    from distributed_deep_q_tpu.solver import Solver
+
+    cfg = Config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.dp = 2
+    cfg.net = NetConfig(kind="nature_cnn", num_actions=4,
+                        frame_shape=(36, 36))
+    cfg.replay = ReplayConfig(capacity=512, batch_size=16, n_step=2,
+                              prioritized=True, device_per=True,
+                              write_chunk=16)
+    solver = Solver(cfg)
+    dev = DevicePERFrameReplay(cfg.replay, solver.mesh, (36, 36), stack=4,
+                               gamma=0.99, seed=0, write_chunk=16)
+    rng = np.random.default_rng(0)
+    for i in range(300):
+        dev.add(rng.integers(0, 255, (36, 36), dtype=np.uint8),
+                int(rng.integers(4)), float(rng.standard_normal()),
+                done=(i % 9 == 8))
+    dev.flush()
+    seed_prio = np.asarray(dev.dstate.prio)
+    seeded = seed_prio[seed_prio > 0]
+    assert np.allclose(seeded, seeded[0])  # all rows at the fresh seed
+    for _ in range(4):
+        solver.train_step_device_per(dev)
+    jax.block_until_ready(solver.state.params)
+    after = np.asarray(dev.dstate.prio)
+    changed = (after > 0) & ~np.isclose(after, seeded[0])
+    assert changed.sum() > 0, "no priority moved off the fresh-row seed"
 
 
 @pytest.mark.slow
@@ -194,3 +226,34 @@ def test_reset_stream_seals_device_boundary():
     gidx = shard * dev._base.cap_local + base + (m._cursor - 1) % dev.slot_cap
     assert after[gidx] == 1
     assert m.boundary[(m._cursor - 1) % dev.slot_cap]  # host seal too
+
+
+@pytest.mark.slow
+def test_distributed_fused_per_end_to_end():
+    """RPC actors streaming pixels into the fused device-PER replay while
+    the learner runs the zero-readback step — the distributed flagship
+    topology (config 3/4 with device_per)."""
+    from distributed_deep_q_tpu.actors.supervisor import train_distributed
+    from distributed_deep_q_tpu.config import pong_config
+
+    cfg = pong_config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.dp = 2
+    cfg.env.id = "signal"
+    cfg.env.kind = "signal_atari"
+    cfg.env.frame_shape = (36, 36)
+    cfg.net.frame_shape = (36, 36)
+    cfg.net.compute_dtype = "float32"
+    cfg.replay = ReplayConfig(capacity=4096, batch_size=16, learn_start=300,
+                              n_step=2, prioritized=True, device_per=True,
+                              write_chunk=16)
+    cfg.train.total_steps = 60
+    cfg.train.target_update_period = 10
+    cfg.train.eval_episodes = 2
+    cfg.actors.num_actors = 3   # 3 streams > 2 shards → sub-rings in play
+    cfg.actors.send_batch = 20
+    cfg.actors.param_sync_period = 25
+    summary = train_distributed(cfg, log_every=20)
+    assert summary["solver"].step == 60
+    assert np.isfinite(summary["loss"])
+    assert summary["env_steps"] >= 300
