@@ -238,6 +238,9 @@ def breakout_config() -> Config:
     c.net = dataclasses.replace(c.net, num_actions=4)
     c.replay = dataclasses.replace(
         c.replay, prioritized=True, n_step=3, batch_size=512,
+        # fused device-PER is the production prioritized path on TPU
+        # (replay/device_per.py); host sum-tree remains the fallback
+        device_per=True,
         # β anneals per sample() (= per grad step): reach β=1 by end of
         # training (total_steps env steps / train_every)
         priority_beta_steps=c.train.total_steps // c.train.train_every)
@@ -270,7 +273,10 @@ def r2d2_config() -> Config:
     c = apex_config()
     c.net = dataclasses.replace(c.net, kind="r2d2", lstm_size=512)
     c.replay = dataclasses.replace(
-        c.replay, sequence_length=80, burn_in=40, batch_size=64)
+        c.replay, sequence_length=80, burn_in=40, batch_size=64,
+        # sequence replay prioritizes whole sequences on the host; the
+        # fused transition-level device-PER path does not apply here
+        device_per=False)
     c.env = dataclasses.replace(c.env, games=(), full_action_space=False)
     return c
 
