@@ -118,3 +118,79 @@ def test_writer_vs_sampler_stress_device_ring():
     dev.flush()
     b = dev.sample(64)
     assert np.isfinite(b["weight"]).all()
+
+
+@pytest.mark.slow
+def test_writer_vs_fused_sampler_stress_device_per():
+    """Same storm as the device-ring stress, on the FUSED path: writers
+    hammer ``add_batch`` (staging + widened flush) while a learner thread
+    runs fused sample+train+priority-update steps, all under the
+    production lock. No exceptions, consistent metadata, live priorities."""
+    from distributed_deep_q_tpu.config import (
+        Config, MeshConfig, NetConfig, ReplayConfig)
+    from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
+    from distributed_deep_q_tpu.solver import Solver
+
+    writers, chunks, chunk = 4, 60, 16
+    cfg = Config()
+    cfg.mesh = MeshConfig(backend="cpu", num_fake_devices=8, dp=2)
+    cfg.net = NetConfig(kind="nature_cnn", num_actions=4,
+                        frame_shape=(36, 36))
+    cfg.replay = ReplayConfig(capacity=4096, batch_size=16, n_step=2,
+                              prioritized=True, device_per=True,
+                              write_chunk=16)
+    solver = Solver(cfg)
+    dev = DevicePERFrameReplay(cfg.replay, solver.mesh, (36, 36), stack=4,
+                               gamma=0.99, seed=0, write_chunk=16,
+                               num_streams=writers)
+    lock = threading.Lock()
+    errors: list[str] = []
+    steps = [0]
+    writers_done = threading.Event()
+
+    def writer(i: int) -> None:
+        try:
+            rng = np.random.default_rng(i)
+            for t in range(chunks):
+                done = np.zeros(chunk, bool)
+                done[-1] = t % 3 == 2
+                with lock:
+                    dev.add_batch({
+                        "frame": rng.integers(0, 255, (chunk, 36, 36),
+                                              np.uint8),
+                        "action": rng.integers(0, 4, chunk).astype(np.int32),
+                        "reward": rng.standard_normal(chunk).astype(
+                            np.float32),
+                        "done": done,
+                    }, stream=i)
+        except Exception as e:
+            errors.append(f"writer {i}: {type(e).__name__}: {e}")
+
+    def learner() -> None:
+        try:
+            while not writers_done.is_set() or steps[0] < 10:
+                with lock:
+                    if dev.ready(600):
+                        m = solver.train_step_device_per(dev)
+                        steps[0] += 1
+                time.sleep(0)
+            assert np.isfinite(float(m["loss"]))
+        except Exception as e:
+            errors.append(f"learner: {type(e).__name__}: {e}")
+
+    ths = [threading.Thread(target=writer, args=(i,)) for i in range(writers)]
+    lt = threading.Thread(target=learner)
+    lt.start()
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(timeout=180)
+    writers_done.set()
+    lt.join(timeout=180)
+
+    assert errors == [], errors
+    assert steps[0] >= 10
+    assert dev.steps_added == writers * chunks * chunk
+    dev.flush()
+    prio = np.asarray(dev.dstate.prio)
+    assert np.isfinite(prio).all() and (prio > 0).sum() > 0
