@@ -161,3 +161,43 @@ def test_uneven_device_split_rejected():
         initialize_multihost(MeshConfig(backend="cpu", num_fake_devices=8,
                                         num_processes=3,
                                         coordinator="127.0.0.1:1"))
+
+
+@pytest.mark.slow
+def test_cli_train_two_process_pixel_per():
+    """Multi-host PIXEL training (config-5-shape): two processes, global
+    mesh, per-host SignalAtari env + host frame replay shard with PER —
+    exercises cross-host pmean on the CNN step and the multi-host
+    local_rows priority write-back."""
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "distributed_deep_q_tpu.main", "train",
+             "--preset", "pong", "--backend", "cpu",
+             "--set", f"mesh.coordinator=127.0.0.1:{port}",
+             "mesh.num_processes=2", f"mesh.process_id={pid}",
+             "mesh.num_fake_devices=8",
+             "env.kind=signal_atari", "env.id=signal",
+             "env.frame_shape=36,36", "net.frame_shape=36,36",
+             "net.compute_dtype=float32",
+             "replay.device_resident=false", "replay.prioritized=true",
+             "replay.device_per=false",
+             "replay.capacity=4096", "replay.batch_size=16",
+             "replay.learn_start=300", "replay.write_chunk=16",
+             "train.total_steps=600", "train.train_every=4",
+             "train.target_update_period=20", "train.eval_every=0",
+             "train.keep_best_eval=false", "train.eval_episodes=2"],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    outs = [p.communicate(timeout=900) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, (
+            f"pixel multihost proc failed rc={p.returncode}\n"
+            f"{se.decode()[-2000:]}")
+    import json
+    summary = json.loads(outs[0][0].decode().strip().splitlines()[-1])
+    assert summary["mode"] == "train"
+    assert "eval_return" in summary
