@@ -30,12 +30,12 @@ unchanged — the device copies exist so composition never needs the host.
 
 from __future__ import annotations
 
-from typing import Any
-
 import flax.struct
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
 
 
 class DeviceReplayState(flax.struct.PyTreeNode):
@@ -226,49 +226,45 @@ def scatter_priorities(prio: jax.Array, maxp: jax.Array, idx: jax.Array,
 # ---------------------------------------------------------------------------
 
 
-class DevicePERFrameReplay:
+class DevicePERFrameReplay(DeviceFrameReplay):
     """Frame ring + metadata + priorities all device-resident; sampling
     and priority updates happen inside the fused learner step
     (``Learner.train_step_device_per``), so per step the host ships only
     per-slot cursors/sizes (~a few hundred bytes) and reads back nothing.
 
-    Host-side slot bookkeeping reuses ``DeviceFrameReplay``'s machinery
-    (stream→slot routing, seal-on-restart, ready gating); this class
-    mirrors every accepted row into the device rings at flush time.
+    Subclasses ``DeviceFrameReplay`` for all host-side slot bookkeeping
+    (stream→slot routing, seal-on-restart, ready gating, the generic
+    chunked flush); the overrides widen the staging pipeline with
+    metadata columns and route writes to the full-state scatter.
     """
-
-    prioritized = True
 
     def __init__(self, cfg, mesh, frame_shape=(84, 84), stack: int = 4,
                  gamma: float = 0.99, seed: int = 0, write_chunk: int = 64,
                  num_streams: int = 1):
         import dataclasses
 
-        import numpy as np
-        from jax.sharding import NamedSharding, PartitionSpec as P
         from jax import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
         from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
-        from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
 
         # host trees off: priorities live on device
-        host_cfg = dataclasses.replace(cfg, prioritized=False)
-        self._base = DeviceFrameReplay(host_cfg, mesh, frame_shape, stack,
-                                       gamma, seed, write_chunk, num_streams)
-        self._cfg = cfg
-        self.mesh = mesh
-        self.stack, self.n_step, self.gamma = int(stack), cfg.n_step, gamma
-        self.frame_shape = tuple(frame_shape)
-        self._samples = 0
+        super().__init__(dataclasses.replace(cfg, prioritized=False), mesh,
+                         frame_shape, stack, gamma, seed, write_chunk,
+                         num_streams)
+        self.prioritized = True
+        self._cfg = cfg  # base stored the trees-off copy; β fields match
+        self.n_step, self.gamma = cfg.n_step, gamma
+        self._stage_columns += [
+            ((), np.int32), ((), np.float32), ((), np.uint8), ((), np.uint8)]
 
-        b = self._base
         sharded = NamedSharding(mesh, P(AXIS_DP))
         replicated = NamedSharding(mesh, P())
-        cap = b.capacity
+        cap = self.capacity
 
         # metadata/priority rings allocated directly on the mesh; the frame
-        # ring is ADOPTED from the base (NOT closed over in a jit — a
-        # captured 7 GB device array would be lowered as a constant)
+        # ring is ADOPTED from the base allocation (NOT closed over in a
+        # jit — a captured 7 GB device array would be lowered as a constant)
         def init_meta():
             return (jnp.zeros(cap, jnp.int32), jnp.zeros(cap, jnp.float32),
                     jnp.zeros(cap, jnp.uint8), jnp.zeros(cap, jnp.uint8),
@@ -278,33 +274,9 @@ class DevicePERFrameReplay:
             init_meta, out_shardings=(sharded, sharded, sharded, sharded,
                                       sharded, replicated))()
         self.dstate = DeviceReplayState(
-            frames=b.ring, action=action, reward=reward, done=done,
+            frames=self.ring, action=action, reward=reward, done=done,
             boundary=boundary, prio=prio, maxp=maxp)
-        b.ring = None  # the frames now live in dstate (single owner)
-
-        # Widen the base's staging pipeline with the metadata columns and
-        # route its write dispatch to the full-state scatter: the generic
-        # drain in DeviceFrameReplay.flush then serves both classes (no
-        # duplicated FIFO logic, no per-row Python on the ingest path).
-        b._stage_columns = b._stage_columns + [
-            ((), np.int32), ((), np.float32), ((), np.uint8), ((), np.uint8)]
-
-        def stage_with_meta(slot, local, frames_arr):
-            m = b.slots[slot]
-            shard, base_off = b._slot_base(slot)
-            b._pending[shard].append((
-                (base_off + local).astype(np.int32), frames_arr,
-                m.action[local], m.reward[local],
-                m.done[local].astype(np.uint8),
-                m.boundary[local].astype(np.uint8)))
-            b._pending_rows[shard] += len(local)
-
-        b._stage = stage_with_meta
-
-        def apply_write_full(idx, cols):
-            self.dstate = self._write_full(self.dstate, idx, *cols)
-
-        b._apply_write = apply_write_full
+        self.ring = None  # the frames now live in dstate (single owner)
 
         # boundary-only scatter for reset_stream: the device boundary ring
         # must mirror the host seal or the fused sampler would compose
@@ -354,101 +326,77 @@ class DevicePERFrameReplay:
             out_shardings=state_fmt,
             donate_argnums=0)
 
-    # -- delegated host bookkeeping -----------------------------------------
+    # -- overridden write plumbing ------------------------------------------
 
-    def __len__(self):
-        return len(self._base)
+    def _stage(self, slot: int, local, frames_arr) -> None:
+        """Stage (rows, frames, action, reward, done, boundary) — the
+        metadata comes from the host slot arrays the rows were just
+        written to, gathered vectorized (fancy indexing copies)."""
+        m = self.slots[slot]
+        shard, base_off = self._slot_base(slot)
+        self._pending[shard].append((
+            (base_off + local).astype(np.int32), frames_arr,
+            m.action[local], m.reward[local],
+            m.done[local].astype(np.uint8),
+            m.boundary[local].astype(np.uint8)))
+        self._pending_rows[shard] += len(local)
 
-    @property
-    def steps_added(self):
-        return self._base.steps_added
+    def _apply_write(self, idx, cols) -> None:
+        """Route each padded chunk to the full-state scatter, which also
+        seeds the fresh rows' priorities from the device max-priority
+        scalar."""
+        self.dstate = self._write_full(self.dstate, idx, *cols)
 
-    @property
-    def capacity(self):
-        return self._base.capacity
+    def sample(self, batch_size: int):
+        raise TypeError(
+            "DevicePERFrameReplay has no host sample path — sampling is "
+            "fused into the learner step (Solver.train_step_device_per)")
 
-    @property
-    def num_shards(self):
-        return self._base.num_shards
-
-    @property
-    def slot_cap(self):
-        return self._base.slot_cap
-
-    @property
-    def subs_per_shard(self):
-        return self._base.subs_per_shard
-
-    @property
-    def slots(self):
-        return self._base.slots
-
-    def ready(self, learn_start: int) -> bool:
-        return self._base.ready(learn_start)
+    def update_priorities(self, idx, td_abs, sampled_at=None):
+        raise TypeError(
+            "DevicePERFrameReplay has no host priority write-back — the "
+            "fused step scatters (|TD|+eps)^alpha on device itself")
 
     def reset_stream(self, stream: int) -> None:
         """Seal the stream's current slot on HOST AND DEVICE: the fused
         sampler reads the device boundary ring, so a host-only seal would
         let sampled windows straddle the dead writer's seam."""
-        b = self._base
-        if not (0 <= stream < b.num_streams):
+        if not (0 <= stream < self.num_streams):
             return
         # flush FIRST: rows still staged carry their pre-seal boundary
         # values and a later flush would scatter them over the seal
         self.flush()
-        cycle = b._slot_cycle[stream]
-        slot = cycle[b._stream_pos[stream] % len(cycle)]
-        m = b.slots[slot]
-        b.reset_stream(stream)
+        cycle = self._slot_cycle[stream]
+        slot = cycle[self._stream_pos[stream] % len(cycle)]
+        m = self.slots[slot]
+        super().reset_stream(stream)
         if len(m) == 0:
             return
-        local = (m._cursor - 1) % b.slot_cap
-        shard, base_off = b._slot_base(slot)
+        local = (m._cursor - 1) % self.slot_cap
+        shard, base_off = self._slot_base(slot)
         # one lane per shard; non-owners carry an OOB index the scatter drops
-        idx = np.full(b.num_shards, b.cap_local, np.int32)
+        idx = np.full(self.num_shards, self.cap_local, np.int32)
         idx[shard] = base_off + local
         self.dstate = self.dstate.replace(
             boundary=self._seal_writer(self.dstate.boundary, idx))
 
-    @property
-    def beta(self):
-        from distributed_deep_q_tpu.replay.prioritized import beta_at
-        return beta_at(self._samples, self._cfg.priority_beta0,
-                       self._cfg.priority_beta_steps)
+    # -- learner-side inputs -------------------------------------------------
+    # (β comes from the inherited ``beta`` property; the fused path never
+    # calls host ``sample``, so the anneal advances via count_sample)
 
     def count_sample(self) -> None:
         """β anneal is denominated in learner samples (= fused steps)."""
         self._samples += 1
 
-    # -- write path (base machinery, widened at __init__) -------------------
-
-    def add(self, frame, action, reward, done, boundary=None) -> int:
-        return self._base.add(frame, action, reward, done, boundary)
-
-    def add_batch(self, batch, stream: int = 0):
-        return self._base.add_batch(batch, stream=stream)
-
-    def flush(self) -> None:
-        """Drain staged rows through the base's generic chunked flush; the
-        patched ``_apply_write`` routes each padded chunk (frames +
-        metadata columns) to the full-state scatter, which also seeds the
-        fresh rows' priorities from the device max-priority scalar."""
-        self._base.flush()
-
-    # -- learner-side inputs -------------------------------------------------
-
     def device_inputs(self):
         """(cursors, sizes) int32 host arrays, shard-major ``[D·subs]`` so
         ``P('dp')`` hands each device its own sub-rings' state."""
-        import numpy as np
-
-        b = self._base
-        d, subs = b.num_shards, b.subs_per_shard
+        d, subs = self.num_shards, self.subs_per_shard
         cursors = np.zeros(d * subs, np.int32)
         sizes = np.zeros(d * subs, np.int32)
-        for g in range(b.num_slots):
+        for g in range(self.num_slots):
             s, sub = g % d, g // d
-            m = b.slots[g]
+            m = self.slots[g]
             cursors[s * subs + sub] = m._cursor
             sizes[s * subs + sub] = len(m)
         return cursors, sizes

@@ -220,10 +220,10 @@ def test_reset_stream_seals_device_boundary():
     after = np.asarray(dev.dstate.boundary)
     assert after.sum() == before + 1
     # the sealed row is the stream's last written row, on device
-    slot = dev._base._slot_cycle[0][dev._base._stream_pos[0] % 1]
-    m = dev._base.slots[slot]
-    shard, base = dev._base._slot_base(slot)
-    gidx = shard * dev._base.cap_local + base + (m._cursor - 1) % dev.slot_cap
+    slot = dev._slot_cycle[0][dev._stream_pos[0] % 1]
+    m = dev.slots[slot]
+    shard, base = dev._slot_base(slot)
+    gidx = shard * dev.cap_local + base + (m._cursor - 1) % dev.slot_cap
     assert after[gidx] == 1
     assert m.boundary[(m._cursor - 1) % dev.slot_cap]  # host seal too
 
