@@ -194,3 +194,21 @@ def test_writer_vs_fused_sampler_stress_device_per():
     dev.flush()
     prio = np.asarray(dev.dstate.prio)
     assert np.isfinite(prio).all() and (prio > 0).sum() > 0
+
+
+@pytest.mark.slow
+def test_pixel_fleet_64_streams_fused_per():
+    """Config-4's real data path at fleet scale: 64 socket actors stream
+    FRAME chunks into the fused device-PER replay (one sub-ring per
+    stream) while the zero-readback learner steps. Floors conservative
+    for the 1-core box; the measured numbers land in the output."""
+    from fleet_smoke import run_pixel_fleet_smoke
+
+    r = run_pixel_fleet_smoke(num_actors=64, fill_s=5.0, measure_s=6.0)
+    assert r["errors"] == []
+    assert r["streams_seen"] == 64
+    assert r["pixel_burst_ingest_tps"] > 5_000, r
+    assert r["ingest_transitions_per_s"] > 1_000, r
+    assert r["learner_idle_steps_per_s"] > 1
+    assert r["contention_ratio"] > 0.1, r
+    print(r)
