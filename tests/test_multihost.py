@@ -138,9 +138,13 @@ def test_cli_train_two_process():
              "--set", f"mesh.coordinator=127.0.0.1:{port}",
              "mesh.num_processes=2", f"mesh.process_id={pid}",
              "mesh.num_fake_devices=8",
-             "train.total_steps=600", "replay.learn_start=200",
+             # minimal workload: the capability under test is the CLI
+             # bring-up + cross-host learn gate, not training depth (the
+             # box can be heavily contended — this test once blew a 900s
+             # budget at 600 steps during a 2x-slow full-suite window)
+             "train.total_steps=300", "replay.learn_start=150",
              "train.eval_every=0", "train.keep_best_eval=false",
-             "train.eval_episodes=2", "replay.batch_size=64"],
+             "train.eval_episodes=1", "replay.batch_size=64"],
             cwd=REPO, env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE))
     outs = [p.communicate(timeout=900) for p in procs]
