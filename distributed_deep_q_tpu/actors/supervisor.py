@@ -50,6 +50,13 @@ def _probe_envs(cfg: Config):
         if first is None:
             first = e
         counts[g] = e.num_actions
+        if e is not first:
+            # probe envs beyond the first exist only for their action
+            # count — close them (8 live ALE emulators at the apex preset
+            # would otherwise leak until GC)
+            close = getattr(e, "close", None)
+            if close:
+                close()
     if len(set(counts.values())) != 1:
         raise ValueError(
             f"multi-game fleet requires one shared action space, got "
@@ -79,8 +86,13 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedClient
 
     from distributed_deep_q_tpu.config import env_for_actor
-    env = make_env(env_for_actor(cfg.env, actor_id),
-                   seed=cfg.train.seed + 1000 * (actor_id + 1))
+    # global identity: actor_id is the LOCAL id (= per-host replay stream);
+    # seeding and the ε ladder use the fleet-global id so multi-host slices
+    # decorrelate instead of repeating each other (config 5 full shape)
+    gid = actor_id + cfg.actors.actor_id_offset
+    fleet = cfg.actors.fleet_size or cfg.actors.num_actors
+    env = make_env(env_for_actor(cfg.env, gid),
+                   seed=cfg.train.seed + 1000 * (gid + 1))
     cfg.net.num_actions = env.num_actions
     qnet = QNet(cfg.net, seed=cfg.train.seed,
                 obs_dim=int(np.prod(env.obs_shape)))
@@ -88,9 +100,9 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     # announce a fresh writer on this stream id: the server seals the
     # previous writer's slot so no sampled window straddles a restart seam
     client.call("reset_stream")
-    rng = np.random.default_rng(cfg.train.seed + 7777 * (actor_id + 1))
-    eps = actor_epsilon(actor_id, cfg.actors.num_actors,
-                        cfg.actors.eps_base, cfg.actors.eps_alpha)
+    rng = np.random.default_rng(cfg.train.seed + 7777 * (gid + 1))
+    eps = actor_epsilon(gid, fleet, cfg.actors.eps_base,
+                        cfg.actors.eps_alpha)
 
     if cfg.net.kind == "r2d2":
         _recurrent_actor_loop(cfg, env, qnet, client, rng, eps, stop_event,
@@ -142,17 +154,27 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     frame = env.reset()
     obs = stacker.reset(frame) if pixel else frame
     ep_ret = 0.0
+    # per-actor pull phase: de-synchronizes the fleet's θ pulls
+    sync_phase = int(rng.integers(max(cfg.actors.param_sync_period, 1)))
+    hb_period = cfg.actors.heartbeat_period
+    last_beat = time.monotonic()
     try:
         while not stop_event.is_set():
             if max_env_steps and steps >= max_env_steps:
                 break
             # θ refresh over the RPC boundary (SURVEY §5.8: actors pull
-            # every ~param_sync_period env steps)
-            if steps % cfg.actors.param_sync_period == 0:
+            # every ~param_sync_period env steps, phase-jittered per actor)
+            if (steps == 0 or
+                    (steps + sync_phase) % cfg.actors.param_sync_period == 0):
                 new_version, weights = client.get_params(have_version=version)
                 if weights is not None:
                     qnet.set_weights(weights)
                     version = new_version
+            # liveness is explicit, not inferred from data traffic: a slow
+            # env may take arbitrarily long to fill a send_batch
+            if hb_period and time.monotonic() - last_beat >= hb_period:
+                client.call("heartbeat")
+                last_beat = time.monotonic()
 
             if rng.random() < eps:
                 a = int(rng.integers(env.num_actions))
@@ -252,15 +274,22 @@ def _recurrent_actor_loop(cfg: Config, env, qnet, client, rng, eps: float,
     obs = stacker.reset(frame) if pixel else frame
     carry = qnet.initial_state(1)
     ep_ret = 0.0
+    sync_phase = int(rng.integers(max(cfg.actors.param_sync_period, 1)))
+    hb_period = cfg.actors.heartbeat_period
+    last_beat = time.monotonic()
     try:
         while not stop_event.is_set():
             if max_env_steps and steps >= max_env_steps:
                 break
-            if steps % cfg.actors.param_sync_period == 0:
+            if (steps == 0 or
+                    (steps + sync_phase) % cfg.actors.param_sync_period == 0):
                 new_version, weights = client.get_params(have_version=version)
                 if weights is not None:
                     qnet.set_weights(weights)
                     version = new_version
+            if hb_period and time.monotonic() - last_beat >= hb_period:
+                client.call("heartbeat")
+                last_beat = time.monotonic()
 
             carry_before = carry
             q, carry = qnet.forward(np.asarray(obs)[None, None], carry)
@@ -413,13 +442,38 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
 
     solver = Solver(cfg, obs_dim=int(np.prod(obs_shape)))
     import jax
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            "the RPC actor/learner topology is single-controller; for "
-            "multi-host training run the in-process loop on every host "
-            "(train --set mesh.num_processes=N, no --distributed) — each "
-            "host's env feeds its own replay shard and the train step's "
-            "pmean spans hosts (SURVEY §5.8)")
+    pc, pid = jax.process_count(), jax.process_index()
+    local_batch = cfg.replay.batch_size
+    if pc > 1:
+        # config 5 FULL shape (SURVEY §7.3 item 6): every learner process
+        # runs its own ReplayFeed server + actor slice + replay shard;
+        # each samples its batch/pc local rows into the train step, whose
+        # pmean spans hosts (Learner.train_step → global_batch). No data
+        # plane crosses hosts outside the step — actor RPC fans into the
+        # local host only, shards never overlap (dedup-free sampling).
+        from distributed_deep_q_tpu.parallel.multihost import (
+            all_processes_ready, local_rows)
+        if cfg.replay.batch_size % pc:
+            raise ValueError(f"replay.batch_size={cfg.replay.batch_size} "
+                             f"must divide across {pc} processes")
+        if cfg.actors.num_actors % pc:
+            raise ValueError(f"actors.num_actors={cfg.actors.num_actors} "
+                             f"must divide across {pc} processes")
+        if pixel and cfg.replay.device_resident:
+            raise ValueError(
+                "the mesh-sharded HBM ring is single-controller; multi-host "
+                "--distributed pixel runs need replay.device_resident=false "
+                "(per-host host-RAM shards feeding global_batch)")
+        local_batch = cfg.replay.batch_size // pc
+        k = cfg.actors.num_actors // pc
+        # local ids 0..k-1 double as this host's replay streams; global
+        # identity (ε ladder / env seeds / multi-game assignment) comes
+        # from the offset
+        cfg = cfg.replace(actors=dataclasses.replace(
+            cfg.actors, num_actors=k, actor_id_offset=pid * k,
+            fleet_size=cfg.actors.num_actors))
+        if pid != 0:
+            metrics = Metrics()  # file/TB sinks live on process 0 only
     from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
     if pixel and cfg.replay.device_resident:
         # fused device PER (prioritized + device_per): the learner step
@@ -460,8 +514,11 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     writeback = None
     if replay.prioritized and not fused_per:
         from distributed_deep_q_tpu.replay.prioritized import make_writeback
+        # multi-host: each process writes back only its own rows of the
+        # batch-sharded |TD|, into its own shard (local_rows)
         writeback = make_writeback(replay, cfg.replay,
-                                   lock=server.replay_lock)
+                                   lock=server.replay_lock,
+                                   to_host=local_rows if pc > 1 else None)
     summary: dict = {}
     from distributed_deep_q_tpu.profiling import (
         StepTimer, TraceWindow, start_profiler_server)
@@ -477,27 +534,55 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
         server.publish_params(solver.get_weights())
     stager = None
     try:
-        # wait for warm-up fill (actors are streaming meanwhile)
-        while not replay.ready(cfg.replay.learn_start):
-            time.sleep(0.05)
-        if not (isinstance(replay, DeviceFrameReplay) or fused_per):
+        # wait for warm-up fill (actors are streaming meanwhile). Multi-
+        # host: the gate opens only when EVERY host's shard is warm — the
+        # sharded train step is a collective, no process may enter early.
+        # all_processes_ready is itself a collective, so the polling
+        # processes proceed in lockstep.
+        if pc == 1:
+            while not replay.ready(cfg.replay.learn_start):
+                time.sleep(0.05)
+        else:
+            while not all_processes_ready(
+                    replay.ready(cfg.replay.learn_start)):
+                time.sleep(0.05)
+        if not (isinstance(replay, DeviceFrameReplay) or fused_per) \
+                and pc == 1:
             # host-batch path: double-buffered sample → device_put pipeline
             # (SURVEY §7.3 item 1); shares the server's replay lock so the
             # background sampler serializes with RPC writers and with PER
-            # priority write-back below
+            # priority write-back below. Multi-host skips the stager: the
+            # global batch assembles from process-local numpy rows inside
+            # train_step, so the sample stays synchronous under the lock.
             from distributed_deep_q_tpu.replay.staging import DeviceStager
             stager = DeviceStager(
-                lambda: replay.sample(cfg.replay.batch_size),
+                lambda: replay.sample(local_batch),
                 sharding=solver.learner._batch_sharding, depth=2,
                 lock=server.replay_lock)
+        chain = max(int(cfg.replay.fused_chain), 1) if fused_per else 1
+        fused_chunk, pending = None, 0
         for gstep in range(1, cfg.train.total_steps + 1):
             if fused_per:
-                # the fused step flushes staged actor rows + dispatches in
-                # one go; the lock serializes against RPC writers so the
-                # donated device state can't be swapped mid-dispatch
-                with server.replay_lock:
-                    with timer.phase("dispatch"):
-                        m = solver.train_step_device_per(replay)
+                # the fused chunk flushes staged actor rows + dispatches
+                # `chain` scanned grad steps in one go; the lock serializes
+                # against RPC writers so the donated device state can't be
+                # swapped mid-dispatch (and is released while the chunk
+                # executes on device — writers get the whole window)
+                if pending == 0:
+                    # tail clamp keeps the grad-step total exact; when
+                    # total_steps % chain != 0 the final partial chunk
+                    # compiles one extra (smaller) program pair at the
+                    # very end of training — pick total_steps a multiple
+                    # of fused_chain to avoid it
+                    pending = min(chain, cfg.train.total_steps - gstep + 1)
+                    with server.replay_lock:
+                        with timer.phase("dispatch"):
+                            fused_chunk = solver.train_steps_device_per(
+                                replay, chain=pending)
+                    fused_off = pending
+                m = {k: v[fused_off - pending]
+                     for k, v in fused_chunk.items()}
+                pending -= 1
             elif isinstance(replay, DeviceFrameReplay):
                 # sample AND dispatch under the lock: a concurrent actor
                 # flush donates the current ring buffer, so the step must be
@@ -505,14 +590,19 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                 # (dispatch is µs; device execution stays async)
                 with server.replay_lock:
                     with timer.phase("sample"):
-                        batch = replay.sample(cfg.replay.batch_size)
+                        batch = replay.sample(local_batch)
                     sampled_at = batch.pop("_sampled_at")
                     with timer.phase("dispatch"):
                         m = solver.train_step_from_ring(
                             replay.ring, batch, replay.frame_shape)
             else:
-                with timer.phase("sample"):  # wait on the staging pipeline
-                    batch = stager.get()
+                if stager is not None:
+                    with timer.phase("sample"):  # wait on the pipeline
+                        batch = stager.get()
+                else:  # multi-host: synchronous local-shard sample
+                    with server.replay_lock:
+                        with timer.phase("sample"):
+                            batch = replay.sample(local_batch)
                 sampled_at = batch.pop("_sampled_at", replay.steps_added)
                 with timer.phase("dispatch"):
                     m = solver.train_step(batch)
@@ -590,15 +680,58 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
     del probe
 
     solver = SequenceSolver(cfg, obs_dim=obs_dim)
+    import dataclasses
+
+    import jax
+    pc, pid = jax.process_count(), jax.process_index()
+    local_batch = cfg.replay.batch_size
+    if pc > 1:
+        # config 5 full shape, recurrent edition: per-host server + actor
+        # slice + sequence-replay shard; the sequence train step's pmean
+        # spans hosts (SequenceLearner.train_step → global_batch)
+        from distributed_deep_q_tpu.parallel.multihost import (
+            all_processes_ready, local_rows)
+        if cfg.replay.batch_size % pc:
+            raise ValueError(f"replay.batch_size={cfg.replay.batch_size} "
+                             f"must divide across {pc} processes")
+        if cfg.actors.num_actors % pc:
+            raise ValueError(f"actors.num_actors={cfg.actors.num_actors} "
+                             f"must divide across {pc} processes")
+        if pixel and cfg.replay.device_resident:
+            raise ValueError(
+                "the device sequence ring is single-controller; multi-host "
+                "recurrent --distributed needs replay.device_resident=false")
+        local_batch = cfg.replay.batch_size // pc
+        k = cfg.actors.num_actors // pc
+        cfg = cfg.replace(actors=dataclasses.replace(
+            cfg.actors, num_actors=k, actor_id_offset=pid * k,
+            fleet_size=cfg.actors.num_actors))
+        if pid != 0:
+            metrics = Metrics()
     seq_len = cfg.replay.sequence_length
     # transition-denominated config fields scale down to sequence units;
     # β anneal runs per sample() = per grad step in this topology
-    replay = SequenceReplay(
-        max(cfg.replay.capacity // seq_len, 64), seq_len, obs_shape,
-        obs_dtype, cfg.net.lstm_size, prioritized=cfg.replay.prioritized,
-        alpha=cfg.replay.priority_alpha, beta0=cfg.replay.priority_beta0,
-        beta_steps=cfg.train.total_steps, eps=cfg.replay.priority_eps,
-        seed=cfg.train.seed, use_native=cfg.replay.use_native)
+    seq_capacity = max(cfg.replay.capacity // seq_len, 64)
+    device_seq = pixel and cfg.replay.device_resident and pc == 1
+    if device_seq:
+        # R2D2 pixel plane in HBM (replay/device_sequence.py): actors
+        # stream stacked sequences over RPC unchanged; the server derives
+        # the unstacked frame streams and scatters them into the ring once
+        from distributed_deep_q_tpu.replay.device_sequence import (
+            DeviceSequenceReplay)
+        replay = DeviceSequenceReplay(
+            seq_capacity, seq_len, obs_shape, solver.mesh,
+            cfg.net.lstm_size, prioritized=cfg.replay.prioritized,
+            alpha=cfg.replay.priority_alpha, beta0=cfg.replay.priority_beta0,
+            beta_steps=cfg.train.total_steps, eps=cfg.replay.priority_eps,
+            seed=cfg.train.seed, use_native=cfg.replay.use_native)
+    else:
+        replay = SequenceReplay(
+            seq_capacity, seq_len, obs_shape,
+            obs_dtype, cfg.net.lstm_size, prioritized=cfg.replay.prioritized,
+            alpha=cfg.replay.priority_alpha, beta0=cfg.replay.priority_beta0,
+            beta_steps=cfg.train.total_steps, eps=cfg.replay.priority_eps,
+            seed=cfg.train.seed, use_native=cfg.replay.use_native)
     learn_start_seqs = max(cfg.replay.learn_start // seq_len, 2)
 
     server = ReplayFeedServer(replay, host=cfg.actors.host, port=0)
@@ -618,16 +751,32 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
     if replay.prioritized:
         from distributed_deep_q_tpu.replay.prioritized import make_writeback
         writeback = make_writeback(replay, cfg.replay,
-                                   lock=server.replay_lock)
+                                   lock=server.replay_lock,
+                                   to_host=local_rows if pc > 1 else None)
     summary: dict = {}
     try:
-        while not replay.ready(learn_start_seqs):
-            time.sleep(0.05)
+        if pc == 1:
+            while not replay.ready(learn_start_seqs):
+                time.sleep(0.05)
+        else:
+            # collective learn gate — see train_distributed
+            while not all_processes_ready(replay.ready(learn_start_seqs)):
+                time.sleep(0.05)
         for gstep in range(1, cfg.train.total_steps + 1):
-            with server.replay_lock:
-                batch = replay.sample(cfg.replay.batch_size)
-                sampled_at = batch.pop("_sampled_at")
-            m = solver.train_step(batch)
+            if device_seq:
+                # sample AND dispatch under the lock: a concurrent RPC
+                # flush donates the ring buffer, so the gather program
+                # must be enqueued before the handle can be invalidated
+                # (same discipline as the DeviceFrameReplay loop above)
+                with server.replay_lock:
+                    batch = replay.sample(local_batch)
+                    sampled_at = batch.pop("_sampled_at")
+                    m = solver.train_step_from_ring(replay, batch)
+            else:
+                with server.replay_lock:
+                    batch = replay.sample(local_batch)
+                    sampled_at = batch.pop("_sampled_at")
+                m = solver.train_step(batch)
             metrics.count("grad_steps")
 
             if replay.prioritized:

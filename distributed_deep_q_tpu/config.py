@@ -54,6 +54,14 @@ class ReplayConfig:
     # sampling/priority-update fuse into the train step (zero host round
     # trips — replay/device_per.py); needs device_resident + prioritized
     device_per: bool = False
+    # grad steps chained per fused-PER dispatch (lax.scan inside the two
+    # XLA programs): dispatch + host bookkeeping amortize over the chunk;
+    # sampling within a chunk sees chunk-start priorities (staleness ≤
+    # fused_chain steps — same bound as priority_writeback_delay on the
+    # host path). Applies where grad steps run back-to-back (the
+    # decoupled distributed learner, benches); the in-process loop chains
+    # at most grad_steps_per_train to keep its env/learn cadence
+    fused_chain: int = 8
     n_step: int = 1
     # minimum fill before learning starts
     learn_start: int = 1_000
@@ -67,6 +75,11 @@ class ReplayConfig:
     sequence_length: int = 80
     burn_in: int = 40
     use_native: bool = True  # use the C++ replay core when available
+    # optional replay persistence (SURVEY §5.4): when set, the buffer's
+    # complete sampling state (rings, cursors, trees, RNG) is dumped to
+    # this .npz alongside learner checkpoints and restored on
+    # train.resume. Default empty = warm-refill, matching the reference
+    persist_path: str = ""
 
 
 @dataclass
@@ -134,6 +147,14 @@ class EnvConfig:
 @dataclass
 class ActorConfig:
     num_actors: int = 1
+    # multi-host fleets (config 5 full shape): each learner process runs
+    # its own supervisor over a slice of the fleet. Local actor ids stay
+    # 0..k-1 (they double as per-host replay stream ids); the offset and
+    # global fleet size give every actor its GLOBAL identity for the ε
+    # ladder and env seeding, so host slices cover different ladder
+    # segments instead of repeating the same one
+    actor_id_offset: int = 0
+    fleet_size: int = 0  # 0 = num_actors (single-host)
     # Ape-X ε ladder: actor i uses ε = base ** (1 + i/(N-1) * alpha) [T]
     eps_base: float = 0.4
     eps_alpha: float = 7.0
@@ -142,8 +163,15 @@ class ActorConfig:
     eps_end: float = 0.05
     eps_decay_steps: int = 10_000
     eval_eps: float = 0.05
-    # pull fresh θ from the learner every this many env steps (SURVEY §5.8)
+    # pull fresh θ from the learner every this many env steps (SURVEY §5.8);
+    # each actor offsets its pull schedule by a stable random phase so a
+    # 256-actor fleet doesn't stampede the learner host in lockstep
     param_sync_period: int = 400
+    # wall-clock seconds between explicit liveness heartbeats (0 disables).
+    # Liveness must not be inferred from data traffic alone: a healthy
+    # actor in a slow env can legitimately go > heartbeat_timeout without
+    # filling a send_batch (VERDICT r3 weak #5)
+    heartbeat_period: float = 5.0
     # transitions per RPC AddTransitions message
     send_batch: int = 64
     # replay-feed service address

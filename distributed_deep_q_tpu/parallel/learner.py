@@ -220,18 +220,30 @@ class Learner:
             self._ring_steps[key] = self._build_ring_step(key)
         return self._ring_steps[key](state, ring, batch)
 
-    def _build_device_per_step(self, spec: tuple):
+    def _build_device_per_step(self, spec: tuple, chain: int):
         """Fused prioritized step (replay/device_per.py): per shard —
         validity mask → inverse-CDF prioritized draw → on-device stack +
         n-step composition → DQN step → same-step priority scatter. The
-        host ships per-slot cursors/sizes and β; NOTHING is read back
-        (the per-sample |TD| never leaves the device)."""
+        host ships per-slot cursors/sizes, β, and sampling keys; NOTHING
+        is read back (the per-sample |TD| never leaves the device).
+
+        ``chain`` > 1 amortizes dispatch: each program ``lax.scan``s its
+        body ``chain`` times per call, so the host pays flush/cursor/key
+        bookkeeping and TWO dispatches per ``chain`` grad steps instead of
+        per step. Semantics of the chained chunk: the SAMPLE program draws
+        all ``chain`` batches against the priorities as of chunk start
+        (within-chunk staleness ≤ chain steps — the same bound the host
+        path's ``DelayedPriorityWriteback(depth=8)`` already accepts),
+        while the TRAIN program applies the ``chain`` optimizer steps and
+        priority scatters strictly in order. Across chunks everything is
+        fresh."""
         (slot_cap, stack, n_step, gamma, frame_shape, per_shard, alpha,
-         eps, num_shards, seed) = spec
+         eps, num_shards) = spec
         from distributed_deep_q_tpu.replay.device_per import (
-            DeviceReplayState, fused_sample, scatter_priorities)
+            fused_sample, scatter_priorities, stack_rows_to_obs)
 
         S = P(AXIS_DP)
+        SK = P(None, AXIS_DP)  # [chain, B]-stacked outputs, batch-sharded
 
         # TWO programs, not one, and NO key derivation on device. Two
         # measured XLA:TPU pathologies shape this structure (each costs a
@@ -249,58 +261,78 @@ class Learner:
         # program does the reshape + CNN + priority scatter.
 
         def sample_fn(keys, frames, action, reward, done, boundary, prio,
-                      cursors, sizes, beta):
+                      cursors, sizes, betas):
             shard_rows = {
                 "frames": frames, "action": action, "reward": reward,
                 "done": done, "boundary": boundary, "prio": prio,
             }
-            return fused_sample(
-                keys[0], shard_rows, cursors, sizes, per_shard, slot_cap,
-                stack, n_step, gamma, beta, num_shards)
+
+            def body(_, key_beta):
+                key, beta = key_beta
+                batch, idx = fused_sample(
+                    key, shard_rows, cursors, sizes, per_shard, slot_cap,
+                    stack, n_step, gamma, beta, num_shards)
+                return _, (batch, idx)
+
+            # keys arrives [1, chain, 2] per shard (sharded over dim 0)
+            _, (batches, idxs) = lax.scan(body, 0, (keys[0], betas))
+            return batches, idxs
 
         sample = jax.jit(shard_map(
             sample_fn, mesh=self.mesh,
             in_specs=(S, S, S, S, S, S, S, S, S, P()),
-            out_specs=({k: S for k in ("obs_rows", "nobs_rows", "action",
-                                       "reward", "discount", "weight")}, S),
+            out_specs=({k: SK for k in ("obs_rows", "nobs_rows", "action",
+                                        "reward", "discount", "weight")},
+                       SK),
             check_vma=False))
 
-        def train_fn(state: TrainState, batch, idx, prio, maxp):
-            from distributed_deep_q_tpu.replay.device_per import (
-                stack_rows_to_obs)
-            batch = dict(batch)
-            batch["obs"] = stack_rows_to_obs(batch.pop("obs_rows"),
-                                             frame_shape)
-            batch["next_obs"] = stack_rows_to_obs(batch.pop("nobs_rows"),
-                                                  frame_shape)
-            new_state, metrics, td_abs = self._step_core(state, batch)
-            prio, maxp = scatter_priorities(prio, maxp, idx, td_abs,
-                                            alpha, eps)
-            return new_state, prio, maxp, metrics
+        def train_fn(state: TrainState, batches, idxs, prio, maxp):
+            def body(carry, batch_idx):
+                state, prio, maxp = carry
+                batch, idx = batch_idx
+                batch = dict(batch)
+                batch["obs"] = stack_rows_to_obs(batch.pop("obs_rows"),
+                                                 frame_shape)
+                batch["next_obs"] = stack_rows_to_obs(
+                    batch.pop("nobs_rows"), frame_shape)
+                state, metrics, td_abs = self._step_core(state, batch)
+                prio, maxp = scatter_priorities(prio, maxp, idx, td_abs,
+                                                alpha, eps)
+                return (state, prio, maxp), metrics
+
+            (state, prio, maxp), metrics = lax.scan(
+                body, (state, prio, maxp), (batches, idxs))
+            return state, prio, maxp, metrics
 
         train = jax.jit(shard_map(
             train_fn, mesh=self.mesh,
-            in_specs=(P(), S, S, S, P()),
+            in_specs=(P(), {k: SK for k in ("obs_rows", "nobs_rows",
+                                            "action", "reward", "discount",
+                                            "weight")}, SK, S, P()),
             out_specs=(P(), S, P(), P()),
             check_vma=False), donate_argnums=(0, 3, 4))
         return sample, train
 
-    def train_step_device_per(self, state: TrainState, rows, cursors,
-                              sizes, beta: float, spec: tuple):
-        """One sample+train+priority-update step on device PER (two chained
-        XLA programs, zero host→device reads back).
-        Returns (state, new_prio, new_maxp, metrics)."""
-        if spec not in self._device_per_steps:
-            self._device_per_steps[spec] = self._build_device_per_step(spec)
-            self._sample_rng = np.random.default_rng(spec[-1])
-        sample, train = self._device_per_steps[spec]
-        d = self.mesh.shape[AXIS_DP]
-        keys = self._sample_rng.integers(0, 2**32, size=(d, 2),
-                                         dtype=np.uint32)
+    def train_steps_device_per(self, state: TrainState, rows, cursors,
+                               sizes, betas: np.ndarray, keys: np.ndarray,
+                               spec: tuple):
+        """``len(betas)`` fused sample+train+priority-update steps on
+        device PER in ONE two-program dispatch (zero reads back). ``keys``
+        is host-generated ``[D, chain, 2]`` uint32 (the caller owns key
+        derivation — see ``Solver.train_steps_device_per``). Returns
+        (state, new_prio, new_maxp, metrics with a leading [chain] axis).
+        """
+        chain = len(betas)
+        cache_key = (spec, chain)
+        if cache_key not in self._device_per_steps:
+            self._device_per_steps[cache_key] = \
+                self._build_device_per_step(spec, chain)
+        sample, train = self._device_per_steps[cache_key]
         batch, idx = sample(keys, rows.frames, rows.action,
                             rows.reward, rows.done, rows.boundary,
                             rows.prio, np.asarray(cursors),
-                            np.asarray(sizes), np.float32(beta))
+                            np.asarray(sizes),
+                            np.asarray(betas, np.float32))
         return train(state, batch, idx, rows.prio, rows.maxp)
 
     def train_step(self, state: TrainState, batch: dict[str, Any]):

@@ -58,6 +58,8 @@ class SequenceLearner:
         self._replicated = NamedSharding(mesh, P())
         self._batch_sharding = NamedSharding(mesh, P(AXIS_DP))
         self._train_step = self._build_train_step()
+        # device-sequence-ring steps, keyed on (seq_len, stack, frame_shape)
+        self._ring_steps: dict[tuple, Any] = {}
 
     def init_state(self, params: Any) -> TrainState:
         state = TrainState(
@@ -68,7 +70,10 @@ class SequenceLearner:
         )
         return put_replicated(state, self._replicated)
 
-    def _build_train_step(self):
+    def _step_core(self, state: TrainState, batch: dict[str, jax.Array]):
+        """Burn-in + train-window unroll + masked loss + optimizer — the
+        per-shard R2D2 step body, shared by the host-batch program and the
+        device-sequence-ring train program."""
         cfg, burn = self.cfg, self.burn_in
         module, opt = self.module, self.opt
 
@@ -128,14 +133,68 @@ class SequenceLearner:
             }
             return new_state, metrics, priority
 
+        return step_fn(state, batch)
+
+    def _build_train_step(self):
         sharded = shard_map(
-            step_fn,
+            lambda state, batch: self._step_core(state, batch),
             mesh=self.mesh,
             in_specs=(P(), P(AXIS_DP)),
             out_specs=(P(), P(), P(AXIS_DP)),
             check_vma=False,
         )
         return jax.jit(sharded, donate_argnums=0)
+
+    def _build_ring_step(self, geom: tuple):
+        """R2D2 step fed by the device-resident sequence ring
+        (replay/device_sequence.py): TWO programs, mirroring the fused
+        transition path's measured layout discipline — the SAMPLE program
+        gathers the [b, T+1, stack] window rows from the local ring shard
+        and returns them flat (gather-natural); the TRAIN program reshapes
+        to [b, T+1, H, W, S] and runs the recurrent step. Pixels never
+        cross the host boundary per step — only KB-scale metadata does."""
+        seq_len, stack, frame_shape = geom
+        from distributed_deep_q_tpu.replay.device_sequence import (
+            compose_sequence_rows)
+
+        S = P(AXIS_DP)
+
+        def sample_fn(ring, seq_local, n_valid):
+            return compose_sequence_rows(ring, seq_local, n_valid,
+                                         seq_len, stack)
+
+        sample = jax.jit(shard_map(
+            sample_fn, mesh=self.mesh, in_specs=(S, S, S), out_specs=S,
+            check_vma=False))
+
+        def train_fn(state: TrainState, rows, batch):
+            h, w = frame_shape
+            obs = rows.reshape(rows.shape[:3] + (h, w))
+            batch = dict(batch)
+            batch["obs"] = jnp.moveaxis(obs, 2, -1)  # [b, T+1, H, W, S]
+            return self._step_core(state, batch)
+
+        train = jax.jit(shard_map(
+            train_fn, mesh=self.mesh,
+            in_specs=(P(), S, S),
+            out_specs=(P(), P(), S),
+            check_vma=False), donate_argnums=0)
+        return sample, train
+
+    def train_step_from_ring(self, state: TrainState, ring, batch,
+                             seq_len: int, stack: int,
+                             frame_shape: tuple[int, int]):
+        """One DP step composing sequence pixels from the HBM ring; returns
+        (state, metrics, per-sequence priority [B])."""
+        geom = (int(seq_len), int(stack), tuple(frame_shape))
+        if geom not in self._ring_steps:
+            self._ring_steps[geom] = self._build_ring_step(geom)
+        sample, train = self._ring_steps[geom]
+        rows = sample(ring, np.asarray(batch["seq_local"], np.int32),
+                      np.asarray(batch["n_valid"], np.int32))
+        meta = {k: v for k, v in batch.items()
+                if k not in ("seq_local", "n_valid")}
+        return train(state, rows, meta)
 
     def train_step(self, state: TrainState, batch: dict[str, Any]):
         """One synchronous DP step over a [B, T_total(+1)] sequence batch;
@@ -188,6 +247,20 @@ class SequenceSolver:
             self.state, self._strip(batch))
         out: dict[str, Any] = dict(metrics)
         out["td_abs"] = priority  # per-sequence priority for PER write-back
+        if "index" in batch:
+            out["index"] = batch["index"]
+        return out
+
+    def train_step_from_ring(self, replay, batch: dict[str, Any],
+                             ) -> dict[str, Any]:
+        """One R2D2 step with pixels composed from the device-resident
+        sequence ring (``DeviceSequenceReplay``): ``batch`` carries only
+        sequence metadata + shard-local slot indices."""
+        self.state, metrics, priority = self.learner.train_step_from_ring(
+            self.state, replay.ring, self._strip(batch), replay.seq_len,
+            replay.stack, replay.frame_shape)
+        out: dict[str, Any] = dict(metrics)
+        out["td_abs"] = priority
         if "index" in batch:
             out["index"] = batch["index"]
         return out

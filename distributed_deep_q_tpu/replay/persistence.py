@@ -1,0 +1,210 @@
+"""Optional replay persistence — npz dump/load of every replay tier.
+
+SURVEY.md §5.4: the reference family optionally persisted the replay buffer
+(HDF5-backed variant [R]); the rebuild's default stays warm-refill (matching
+reference behavior), and this module supplies the opt-in persistence behind
+``ReplayConfig.persist_path``. One ``.npz`` file carries the complete
+sampling state of a buffer — ring contents, cursors, priority trees, the
+β-anneal counter, and the numpy RNG states — so a restored buffer's next
+``sample()`` is byte-identical to what the saved one would have drawn
+(tests/test_persistence.py proves exactly that).
+
+Device-resident tiers (``DeviceFrameReplay`` / ``DevicePERFrameReplay``)
+download their HBM rings once at save (``np.asarray`` on the sharded array
+assembles the global view) and re-upload with the mesh sharding at load —
+persistence is a cold-path operation; nothing here touches the train step.
+
+Format: flat npz keys. Scalars ride as 0-d arrays; RNG states as JSON
+strings. ``meta_kind`` + geometry keys guard against loading a file into a
+mismatched buffer.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+SCHEMA = 1
+
+
+# -- rng state (json round-trip keeps npz dtype-clean) -----------------------
+
+
+def _rng_dump(rng: np.random.Generator) -> str:
+    return json.dumps(rng.bit_generator.state)
+
+
+def _rng_load(rng: np.random.Generator, s: str) -> None:
+    rng.bit_generator.state = json.loads(s)
+
+
+def _str(v) -> str:
+    """npz round-trips str as 0-d ``<U`` arrays."""
+    return str(np.asarray(v)[()]) if not isinstance(v, str) else v
+
+
+# -- per-tier (de)serializers -------------------------------------------------
+
+
+def _frame_stack_state(m, prefix: str) -> dict:
+    d = {
+        f"{prefix}action": m.action, f"{prefix}reward": m.reward,
+        f"{prefix}done": m.done, f"{prefix}boundary": m.boundary,
+        f"{prefix}cursor": m._cursor, f"{prefix}size": m._size,
+        f"{prefix}steps_added": m._steps_added,
+        f"{prefix}rng": _rng_dump(m._rng),
+    }
+    if m.frames is not None:
+        d[f"{prefix}frames"] = m.frames
+    return d
+
+
+def _frame_stack_restore(m, z, prefix: str) -> None:
+    assert int(z[f"{prefix}size"]) <= m.capacity, "capacity shrank under file"
+    m.action[:] = z[f"{prefix}action"]
+    m.reward[:] = z[f"{prefix}reward"]
+    m.done[:] = z[f"{prefix}done"]
+    m.boundary[:] = z[f"{prefix}boundary"]
+    m._cursor = int(z[f"{prefix}cursor"])
+    m._size = int(z[f"{prefix}size"])
+    m._steps_added = int(z[f"{prefix}steps_added"])
+    _rng_load(m._rng, _str(z[f"{prefix}rng"]))
+    if m.frames is not None:
+        m.frames[:] = z[f"{prefix}frames"]
+
+
+def save_replay(replay, path: str) -> None:
+    """Dump ``replay``'s complete sampling state to ``path`` (npz)."""
+    from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
+    from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
+    from distributed_deep_q_tpu.replay.prioritized import PrioritizedReplay
+    from distributed_deep_q_tpu.replay.replay_memory import (
+        FrameStackReplay, ReplayMemory)
+
+    d: dict = {"meta_schema": SCHEMA}
+
+    if isinstance(replay, PrioritizedReplay):
+        d["meta_kind"] = "prioritized"
+        d["tree"] = replay.tree.tree
+        d["max_priority"] = replay.max_priority
+        d["samples"] = replay._samples
+        d["per_rng"] = _rng_dump(replay._rng)
+        base, inner = replay.base, "base_"
+    else:
+        base, inner = replay, ""
+
+    if isinstance(replay, DeviceFrameReplay):  # incl. DevicePERFrameReplay
+        replay.flush()  # staged rows must be in the device state we dump
+        d["meta_kind"] = ("device_per" if isinstance(replay,
+                                                     DevicePERFrameReplay)
+                         else d.get("meta_kind", "device_ring"))
+        d["meta_capacity"] = replay.capacity
+        d["meta_num_slots"] = replay.num_slots
+        d["meta_num_streams"] = replay.num_streams
+        d["stream_pos"] = np.asarray(replay._stream_pos, np.int64)
+        d["max_priority"] = replay.max_priority
+        d["samples"] = replay._samples
+        d["ring_rng"] = _rng_dump(replay._rng)
+        for i, m in enumerate(replay.slots):
+            d.update(_frame_stack_state(m, f"slot{i}_"))
+        if isinstance(replay, DevicePERFrameReplay):
+            for k in ("frames", "action", "reward", "done", "boundary",
+                      "prio", "maxp"):
+                d[f"dev_{k}"] = np.asarray(getattr(replay.dstate, k))
+        else:
+            d["dev_frames"] = np.asarray(replay.ring)
+            if replay.prioritized:
+                for i, t in enumerate(replay.trees):
+                    d[f"tree{i}"] = t.tree
+    elif isinstance(base, FrameStackReplay):
+        d.setdefault("meta_kind", "frame_stack")
+        d["meta_capacity"] = base.capacity
+        d.update(_frame_stack_state(base, inner))
+    elif isinstance(base, ReplayMemory):
+        d.setdefault("meta_kind", "memory")
+        d["meta_capacity"] = base.capacity
+        d.update({
+            f"{inner}obs": base.obs, f"{inner}next_obs": base.next_obs,
+            f"{inner}action": base.action, f"{inner}reward": base.reward,
+            f"{inner}discount": base.discount,
+            f"{inner}cursor": base._cursor, f"{inner}size": base._size,
+            f"{inner}steps_added": base._steps_added,
+            f"{inner}rng": _rng_dump(base._rng),
+        })
+    else:
+        raise TypeError(f"no persistence for {type(replay).__name__}")
+    np.savez(path, **d)
+
+
+def load_replay(replay, path: str) -> None:
+    """Restore state saved by ``save_replay`` into a freshly constructed,
+    geometry-matched ``replay`` (same class, capacity, slot layout)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
+    from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
+    from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
+    from distributed_deep_q_tpu.replay.prioritized import PrioritizedReplay
+    from distributed_deep_q_tpu.replay.replay_memory import (
+        FrameStackReplay, ReplayMemory)
+
+    z = np.load(path, allow_pickle=False)
+    kind = _str(z["meta_kind"])
+
+    if isinstance(replay, PrioritizedReplay):
+        assert kind == "prioritized", f"file holds {kind!r}"
+        replay.tree.set(np.arange(replay.tree.size),
+                        z["tree"][replay.tree.size:
+                                  replay.tree.size + replay.tree.size])
+        replay.max_priority = float(z["max_priority"])
+        replay._samples = int(z["samples"])
+        _rng_load(replay._rng, _str(z["per_rng"]))
+        base, inner = replay.base, "base_"
+    else:
+        base, inner = replay, ""
+
+    if isinstance(replay, DeviceFrameReplay):
+        expect = ("device_per" if isinstance(replay, DevicePERFrameReplay)
+                  else "device_ring")
+        assert kind == expect, f"file holds {kind!r}, buffer is {expect!r}"
+        assert int(z["meta_capacity"]) == replay.capacity and \
+            int(z["meta_num_slots"]) == replay.num_slots, \
+            "ring geometry mismatch (capacity / slot layout)"
+        replay._stream_pos = [int(v) for v in z["stream_pos"]]
+        replay.max_priority = float(z["max_priority"])
+        replay._samples = int(z["samples"])
+        _rng_load(replay._rng, _str(z["ring_rng"]))
+        for i, m in enumerate(replay.slots):
+            _frame_stack_restore(m, z, f"slot{i}_")
+        sharded = NamedSharding(replay.mesh, P(AXIS_DP))
+        if isinstance(replay, DevicePERFrameReplay):
+            replicated = NamedSharding(replay.mesh, P())
+            replay.dstate = replay.dstate.replace(**{
+                k: jax.device_put(z[f"dev_{k}"],
+                                  replicated if k == "maxp" else sharded)
+                for k in ("frames", "action", "reward", "done", "boundary",
+                          "prio", "maxp")})
+            replay._di_cache = None
+        else:
+            replay.ring = jax.device_put(z["dev_frames"], sharded)
+            if replay.prioritized:
+                for i, t in enumerate(replay.trees):
+                    t.set(np.arange(t.size), z[f"tree{i}"][t.size: 2 * t.size])
+    elif isinstance(base, FrameStackReplay):
+        assert int(z["meta_capacity"]) == base.capacity, "capacity mismatch"
+        _frame_stack_restore(base, z, inner)
+    elif isinstance(base, ReplayMemory):
+        assert int(z["meta_capacity"]) == base.capacity, "capacity mismatch"
+        base.obs[:] = z[f"{inner}obs"]
+        base.next_obs[:] = z[f"{inner}next_obs"]
+        base.action[:] = z[f"{inner}action"]
+        base.reward[:] = z[f"{inner}reward"]
+        base.discount[:] = z[f"{inner}discount"]
+        base._cursor = int(z[f"{inner}cursor"])
+        base._size = int(z[f"{inner}size"])
+        base._steps_added = int(z[f"{inner}steps_added"])
+        _rng_load(base._rng, _str(z[f"{inner}rng"]))
+    else:
+        raise TypeError(f"no persistence for {type(replay).__name__}")

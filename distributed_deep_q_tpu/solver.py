@@ -62,6 +62,11 @@ class Solver:
         self.state: TrainState = self.learner.init_state(params)
         self._treedef = jax.tree_util.tree_structure(params)
         self._qv = jax.jit(self.apply_fn)
+        # fused device-PER bookkeeping (see train_steps_device_per)
+        self._dp_spec: tuple | None = None
+        self._dp_spec_replay = None
+        self._fused_key_base: int | None = None
+        self._fused_steps_issued = 0
 
     # -- training ----------------------------------------------------------
 
@@ -106,23 +111,64 @@ class Solver:
         """One FUSED prioritized step on a ``DevicePERFrameReplay``:
         sampling, composition, the gradient step, and the priority update
         are one XLA program; the host ships ~bytes of cursors and reads
-        back nothing (replay/device_per.py)."""
-        replay.flush()  # device rows must cover everything the host
-        # bookkeeping (cursors/sizes below) claims is written
+        back nothing (replay/device_per.py). Metrics come back as device
+        scalars."""
+        m = self.train_steps_device_per(replay, chain=1)
+        return {k: v[0] for k, v in m.items()}
+
+    def train_steps_device_per(self, replay,
+                               chain: int | None = None) -> dict[str, Any]:
+        """``chain`` fused prioritized steps in ONE two-program dispatch
+        (lax.scan inside — see ``Learner._build_device_per_step``). Host
+        cost per chunk: a flush check, (cached) cursor/size arrays, one
+        Philox key draw, two dispatches — amortized over ``chain`` grad
+        steps; this is what closes the matched-batch north star's ~400 µs
+        of per-step host overhead. Returns metrics stacked ``[chain]``
+        (device arrays — convert only when logging)."""
+        chain = chain or max(int(self.config.replay.fused_chain), 1)
+        if any(replay._pending_rows):
+            replay.flush()  # device rows must cover everything the host
+            # bookkeeping (cursors/sizes below) claims is written
         cursors, sizes = replay.device_inputs()
-        beta = replay.beta
-        replay.count_sample()
-        spec = (replay.slot_cap, replay.stack, replay.n_step, replay.gamma,
-                tuple(replay.frame_shape),
-                self.config.replay.batch_size // replay.num_shards,
-                float(self.config.replay.priority_alpha),
-                float(self.config.replay.priority_eps),
-                replay.num_shards, self.config.train.seed)
+        betas = replay.next_betas(chain)
+        spec = self._dp_spec
+        if spec is None or self._dp_spec_replay is not replay:
+            spec = (replay.slot_cap, replay.stack, replay.n_step,
+                    replay.gamma, tuple(replay.frame_shape),
+                    self.config.replay.batch_size // replay.num_shards,
+                    float(self.config.replay.priority_alpha),
+                    float(self.config.replay.priority_eps),
+                    replay.num_shards)
+            self._dp_spec, self._dp_spec_replay = spec, replay
+        keys = self._next_sample_keys(replay.num_shards, chain)
         self.state, prio, maxp, metrics = \
-            self.learner.train_step_device_per(
-                self.state, replay.dstate, cursors, sizes, beta, spec)
+            self.learner.train_steps_device_per(
+                self.state, replay.dstate, cursors, sizes, betas, keys,
+                spec)
         replay.dstate = replay.dstate.replace(prio=prio, maxp=maxp)
         return dict(metrics)
+
+    def _next_sample_keys(self, num_shards: int, chain: int) -> np.ndarray:
+        """Counter-derived device-sampling keys ``[D, chain, 2]``: Philox
+        keyed on the config seed with the counter anchored at the train
+        step the fused path FIRST ran from (read once — never per step:
+        ``int(state.step)`` is a D2H sync). A resumed run therefore
+        continues the key sequence instead of replaying it from the start,
+        and two replay geometries sharing this solver never correlate."""
+        if self._fused_key_base is None:
+            self._fused_key_base = int(jax.device_get(self.state.step))
+            self._fused_steps_issued = 0
+        out = np.empty((num_shards, chain, 2), np.uint32)
+        for i in range(chain):
+            # one counter per grad step (not per chunk): a chain=k chunk
+            # draws byte-identical keys to k single-step dispatches
+            ctr = self._fused_key_base + self._fused_steps_issued + i
+            gen = np.random.Generator(np.random.Philox(
+                key=self.config.train.seed, counter=ctr << 128))
+            out[:, i, :] = gen.integers(0, 2**32, size=(num_shards, 2),
+                                        dtype=np.uint32)
+        self._fused_steps_issued += chain
+        return out
 
     # -- inference (actor path) -------------------------------------------
 

@@ -8,6 +8,8 @@ One process hosts actor + replay + learner; the distributed topology
 
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 
@@ -180,6 +182,16 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
     if ckpt and cfg.train.resume and ckpt.latest_step() is not None:
         solver.state, _ = ckpt.restore(solver.state)
         gsteps = solver.step
+    persist = cfg.replay.persist_path
+    if persist and pc > 1:
+        # per-process shard files: a shared path would race on save and
+        # clone one shard's content (and RNG) onto every host on resume
+        persist = f"{persist}.proc{pid}"
+    if persist and cfg.train.resume and os.path.exists(persist):
+        # opt-in replay persistence (SURVEY §5.4): restore the buffer's
+        # exact sampling state instead of warm-refilling
+        from distributed_deep_q_tpu.replay.persistence import load_replay
+        load_replay(replay, persist)
 
     try:
         for t in range(1, cfg.train.total_steps + 1):
@@ -223,12 +235,29 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
                 learn_live = (ready if pc == 1
                               else all_processes_ready(ready))
             if learn_live and t % cfg.train.train_every == 0:
-                # learn phase: j minibatches per k env steps (SURVEY §3.1 [M])
-                for _ in range(cfg.train.grad_steps_per_train):
+                # learn phase: j minibatches per k env steps (SURVEY §3.1 [M]).
+                # Fused path: chain up to fused_chain of the j steps into one
+                # two-program dispatch (lax.scan); per-step bookkeeping below
+                # reads its row of the chunk's stacked metrics.
+                chain = (min(max(cfg.replay.fused_chain, 1),
+                             cfg.train.grad_steps_per_train)
+                         if fused_per else 1)
+                pending = chunk_len = 0
+                for j in range(cfg.train.grad_steps_per_train):
                     if fused_per:
-                        # sample+train+priority-update fused on device
-                        with timer.phase("dispatch"):
-                            m = solver.train_step_device_per(replay)
+                        # sample+train+priority-update fused on device;
+                        # the tail chunk clamps to the steps actually left
+                        # so the device never applies extra optimizer steps
+                        if pending == 0:
+                            chunk_len = min(
+                                chain, cfg.train.grad_steps_per_train - j)
+                            with timer.phase("dispatch"):
+                                mk = solver.train_steps_device_per(
+                                    replay, chain=chunk_len)
+                            pending = chunk_len
+                        m = {k: v[chunk_len - pending]
+                             for k, v in mk.items()}
+                        pending -= 1
                     else:
                         with timer.phase("sample"):
                             batch = replay.sample(local_batch)
@@ -253,6 +282,10 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
                     metrics.count("grad_steps")
                     if ckpt and gsteps % cfg.train.checkpoint_every == 0:
                         ckpt.save(solver.state, extra={"env_steps": t})
+                        if persist:
+                            from distributed_deep_q_tpu.replay.persistence \
+                                import save_replay
+                            save_replay(replay, persist)
                     # host-side counter: reading solver.step would sync on the
                     # just-dispatched device step every iteration
                     if gsteps % log_every == 0:
@@ -289,6 +322,9 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
     if ckpt:
         ckpt.save(solver.state, extra={"env_steps": cfg.train.total_steps},
                   wait=True)
+    if persist:
+        from distributed_deep_q_tpu.replay.persistence import save_replay
+        save_replay(replay, persist)
     summary["eval_return"] = final_ret
     summary["solver"] = solver
     return summary
@@ -346,12 +382,28 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
     obs_dtype = np.uint8 if pixel else np.float32
 
     seq_len = cfg.replay.sequence_length
-    replay = SequenceReplay(
-        max(cfg.replay.capacity // seq_len, 64), seq_len, obs_shape,
-        obs_dtype, cfg.net.lstm_size, prioritized=cfg.replay.prioritized,
-        alpha=cfg.replay.priority_alpha, beta0=cfg.replay.priority_beta0,
-        beta_steps=cfg.replay.priority_beta_steps,
-        eps=cfg.replay.priority_eps, seed=cfg.train.seed)
+    seq_capacity = max(cfg.replay.capacity // seq_len, 64)
+    device_seq = pixel and cfg.replay.device_resident
+    if device_seq:
+        # R2D2 pixel plane in HBM: frames stored once (unstacked streams),
+        # [B, T+1, H, W, S] windows composed on device — kills the
+        # ~36 MB/step host→device sequence-minibatch transfer
+        # (replay/device_sequence.py)
+        from distributed_deep_q_tpu.replay.device_sequence import (
+            DeviceSequenceReplay)
+        replay = DeviceSequenceReplay(
+            seq_capacity, seq_len, obs_shape, solver.mesh,
+            cfg.net.lstm_size, prioritized=cfg.replay.prioritized,
+            alpha=cfg.replay.priority_alpha, beta0=cfg.replay.priority_beta0,
+            beta_steps=cfg.replay.priority_beta_steps,
+            eps=cfg.replay.priority_eps, seed=cfg.train.seed)
+    else:
+        replay = SequenceReplay(
+            seq_capacity, seq_len, obs_shape,
+            obs_dtype, cfg.net.lstm_size, prioritized=cfg.replay.prioritized,
+            alpha=cfg.replay.priority_alpha, beta0=cfg.replay.priority_beta0,
+            beta_steps=cfg.replay.priority_beta_steps,
+            eps=cfg.replay.priority_eps, seed=cfg.train.seed)
     builder = SequenceBuilder(seq_len, cfg.replay.burn_in, obs_shape,
                               obs_dtype, cfg.net.lstm_size, cfg.train.gamma)
     learn_start_seqs = max(cfg.replay.learn_start // seq_len, 2)
@@ -402,7 +454,10 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
                 and t % cfg.train.train_every == 0):
             batch = replay.sample(cfg.replay.batch_size)
             sampled_at = batch.pop("_sampled_at")
-            m = solver.train_step(batch)
+            if device_seq:
+                m = solver.train_step_from_ring(replay, batch)
+            else:
+                m = solver.train_step(batch)
             gsteps += 1
             if replay.prioritized:
                 writeback.push(m["index"], m["td_abs"], sampled_at)

@@ -1,0 +1,82 @@
+"""Config-5 full-shape worker — spawned by tests/test_multihost.py.
+
+One process of a 2-process multi-controller learner running the FULL
+distributed topology: per-host ReplayFeed server + per-host actor slice
+(real spawned actor processes over RPC) + per-host replay shard, with the
+train step's pmean spanning hosts (SURVEY §7.3 item 6). Process 0 also
+injects a fault: it kills one of its own actors mid-run and the per-host
+supervisor must respawn it.
+
+Prints one JSON line: {env_steps, actor_restarts, loss, grad_steps}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    pid, nproc, port, steps = (int(sys.argv[1]), int(sys.argv[2]),
+                               sys.argv[3], int(sys.argv[4]))
+    kill_an_actor = pid == 0
+
+    from distributed_deep_q_tpu.config import MeshConfig, cartpole_config
+    from distributed_deep_q_tpu.parallel.multihost import initialize_multihost
+
+    cfg = cartpole_config()
+    cfg.mesh = MeshConfig(backend="cpu", num_fake_devices=8,
+                          coordinator=f"127.0.0.1:{port}",
+                          num_processes=nproc, process_id=pid)
+    initialize_multihost(cfg.mesh)
+
+    import numpy as np
+
+    from distributed_deep_q_tpu.actors.supervisor import train_distributed
+
+    cfg.train.total_steps = steps
+    cfg.train.eval_every = 0
+    cfg.train.keep_best_eval = False
+    cfg.train.eval_episodes = 1
+    cfg.replay.learn_start = 120
+    cfg.replay.batch_size = 32
+    cfg.actors.num_actors = 4        # global fleet: 2 per host
+    cfg.actors.send_batch = 16
+    cfg.actors.param_sync_period = 40
+
+    if kill_an_actor:
+        import multiprocessing as mp
+
+        def assassin() -> None:
+            # wait for this host's actor slice to spawn and feed, then
+            # kill one — the per-host supervisor must respawn it
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                kids = [p for p in mp.active_children()
+                        if p.name.startswith("actor-")]
+                if kids:
+                    time.sleep(3.0)  # let it feed a few batches first
+                    kids[0].kill()
+                    return
+                time.sleep(0.2)
+
+        threading.Thread(target=assassin, daemon=True).start()
+
+    summary = train_distributed(cfg, log_every=max(steps // 2, 1))
+    print(json.dumps({
+        "pid": pid,
+        "env_steps": int(summary["env_steps"]),
+        "actor_restarts": int(summary["actor_restarts"]),
+        "loss": float(summary["loss"]),
+        "grad_steps": int(summary["solver"].step),
+        "finite": bool(np.isfinite(summary["loss"])),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -259,6 +259,152 @@ def test_distributed_fused_per_end_to_end():
     assert summary["env_steps"] >= 300
 
 
+def _filled_dev_replay(solver, cfg, alpha_seed=0, n=300):
+    dev = DevicePERFrameReplay(cfg.replay, solver.mesh, (36, 36), stack=4,
+                               gamma=0.99, seed=alpha_seed, write_chunk=16)
+    rng = np.random.default_rng(alpha_seed)
+    for i in range(n):
+        dev.add(rng.integers(0, 255, (36, 36), dtype=np.uint8),
+                int(rng.integers(4)), float(rng.standard_normal()),
+                done=(i % 9 == 8))
+    dev.flush()
+    return dev
+
+
+def test_chained_fused_steps_match_sequential_alpha0():
+    """α=0 makes sampling independent of priorities, so a chain=3 chunk
+    must reproduce THREE sequential single-step dispatches bit-for-bit
+    (same keys/βs) — optimizer state, params, and priorities included."""
+    from distributed_deep_q_tpu.solver import Solver
+
+    def build():
+        cfg = Config()
+        cfg.mesh.backend = "cpu"
+        cfg.mesh.dp = 2
+        cfg.net = NetConfig(kind="nature_cnn", num_actions=4,
+                            frame_shape=(36, 36))
+        cfg.replay = ReplayConfig(capacity=512, batch_size=16, n_step=2,
+                                  prioritized=True, priority_alpha=0.0,
+                                  device_per=True, write_chunk=16,
+                                  fused_chain=3)
+        solver = Solver(cfg)
+        return solver, _filled_dev_replay(solver, cfg)
+
+    sa, da = build()
+    sb, db = build()
+    # pin identical key sequences: both solvers start at step 0 with the
+    # same seed, so Philox counters line up; sequential issues 1+1+1,
+    # chained issues 3 — same counter range, same keys
+    for _ in range(3):
+        sa.train_step_device_per(da)
+    sb.train_steps_device_per(db, chain=3)
+    jax.block_until_ready(sa.state.params)
+    jax.block_until_ready(sb.state.params)
+    for xa, xb in zip(jax.tree.leaves(sa.state), jax.tree.leaves(sb.state)):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+    np.testing.assert_array_equal(np.asarray(da.dstate.prio),
+                                  np.asarray(db.dstate.prio))
+
+
+def test_chained_fused_steps_alpha_positive_learns_and_scatters():
+    """With real PER (α>0) a chained chunk must keep the step total: all
+    chain steps apply (step counter advances by chain), priorities move
+    off the fresh-row seed, and losses are finite."""
+    from distributed_deep_q_tpu.solver import Solver
+
+    cfg = Config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.dp = 2
+    cfg.net = NetConfig(kind="nature_cnn", num_actions=4,
+                        frame_shape=(36, 36))
+    cfg.replay = ReplayConfig(capacity=512, batch_size=16, n_step=2,
+                              prioritized=True, priority_alpha=0.6,
+                              device_per=True, write_chunk=16)
+    solver = Solver(cfg)
+    dev = _filled_dev_replay(solver, cfg)
+    seed_val = np.asarray(dev.dstate.prio).max()
+    m = solver.train_steps_device_per(dev, chain=4)
+    jax.block_until_ready(solver.state.params)
+    assert solver.step == 4
+    assert np.all(np.isfinite(np.asarray(m["loss"]))) and \
+        np.asarray(m["loss"]).shape == (4,)
+    after = np.asarray(dev.dstate.prio)
+    assert ((after > 0) & ~np.isclose(after, seed_val)).sum() > 0
+    # β annealed once per chained step, host-path ordering (advance first)
+    assert dev._samples == 4
+
+
+def test_fused_sample_zero_mass_shard_yields_zero_weights():
+    """A shard with zero masked priority mass must contribute zero-weight
+    rows and drop its priority scatter (OOB index) instead of composing
+    garbage with extreme IS weights."""
+    from distributed_deep_q_tpu.replay.device_per import fused_sample
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(MeshConfig(backend="cpu", num_fake_devices=8, dp=2))
+    cap_local, slot_cap = 64, 64
+    rows = {
+        "frames": jnp.zeros((2 * cap_local, 16), jnp.uint8),
+        "action": jnp.zeros(2 * cap_local, jnp.int32),
+        "reward": jnp.zeros(2 * cap_local, jnp.float32),
+        "done": jnp.zeros(2 * cap_local, jnp.uint8),
+        "boundary": jnp.zeros(2 * cap_local, jnp.uint8),
+        # shard 0 has mass, shard 1 is all-zero (e.g. sealed away)
+        "prio": jnp.concatenate([jnp.ones(cap_local, jnp.float32),
+                                 jnp.zeros(cap_local, jnp.float32)]),
+    }
+    cursors = jnp.asarray([30, 0], jnp.int32)
+    sizes = jnp.asarray([60, 0], jnp.int32)
+
+    def fn(frames, action, reward, done, boundary, prio, cur, siz):
+        shard_rows = {"frames": frames, "action": action, "reward": reward,
+                      "done": done, "boundary": boundary, "prio": prio}
+        batch, idx = fused_sample(jnp.asarray([0, 1], jnp.uint32),
+                                  shard_rows, cur, siz, 8, slot_cap,
+                                  2, 1, 0.99, jnp.float32(0.4), 2)
+        return batch["weight"], idx
+
+    S = P("dp")
+    w, idx = shard_map(
+        fn, mesh=mesh, in_specs=(S,) * 8, out_specs=(S, S),
+        check_vma=False)(
+        rows["frames"], rows["action"], rows["reward"], rows["done"],
+        rows["boundary"], rows["prio"], cursors, sizes)
+    w, idx = np.asarray(w), np.asarray(idx)
+    assert np.all(np.isfinite(w))
+    assert np.all(w[8:] == 0.0), "empty shard's weights must be zero"
+    assert np.all(idx[8:] == cap_local), "empty shard's scatter must be OOB"
+    # live shard normalizes against its OWN max (==1.0 here, uniform p):
+    # the dead shard's floored probabilities must not enter the w_max pmax
+    np.testing.assert_allclose(w[:8], 1.0, atol=1e-6)
+
+
+def test_fused_key_sequence_continues_across_resume():
+    """ADVICE r3: a resumed solver must NOT replay the sampling key
+    sequence from the start — keys derive from the train-step counter."""
+    from distributed_deep_q_tpu.solver import Solver
+
+    cfg = Config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.dp = 2
+    cfg.net = NetConfig(kind="nature_cnn", num_actions=4,
+                        frame_shape=(36, 36))
+    cfg.replay = ReplayConfig(capacity=512, batch_size=16, n_step=2,
+                              prioritized=True, device_per=True,
+                              write_chunk=16)
+    a = Solver(cfg)
+    k1 = a._next_sample_keys(2, 2)
+    k2 = a._next_sample_keys(2, 2)
+    assert not np.array_equal(k1, k2)
+    # fresh solver "resumed" at step 2 (counter base from state.step)
+    b = Solver(cfg)
+    b.state = b.state.replace(step=jnp.asarray(2, jnp.int32))
+    kb = b._next_sample_keys(2, 2)
+    np.testing.assert_array_equal(kb, k2)
+    assert not np.array_equal(kb, k1)
+
+
 def test_alpha_zero_fused_sampler_is_uniform():
     """α=0 (the pong preset's fused-uniform mode): constant priorities ⇒
     exactly-uniform draws and IS weights exactly 1."""
@@ -288,14 +434,17 @@ def test_alpha_zero_fused_sampler_is_uniform():
     prio = np.asarray(dev.dstate.prio)
     np.testing.assert_allclose(prio[prio > 0], 1.0)
     # pull one sample batch through the compiled program: weights == 1
-    spec = list(solver.learner._device_per_steps)[0]
-    sample, _ = solver.learner._device_per_steps[spec]
+    cache_key = list(solver.learner._device_per_steps)[0]
+    sample, _ = solver.learner._device_per_steps[cache_key]
+    chain = cache_key[1]
     cursors, sizes = dev.device_inputs()
-    keys = np.random.default_rng(5).integers(0, 2**32, (2, 2), np.uint32)
+    keys = np.random.default_rng(5).integers(0, 2**32, (2, chain, 2),
+                                             np.uint32)
     rows = dev.dstate
     batch, idx = sample(keys, rows.frames, rows.action, rows.reward,
                         rows.done, rows.boundary, rows.prio, cursors,
-                        sizes, np.float32(0.4))
+                        sizes, np.full(chain, 0.4, np.float32))
+    batch = {k: v[0] for k, v in batch.items()}  # first chunk row
     w = np.asarray(batch["weight"])
     # per shard the draw is exactly uniform → constant weight; across
     # shards the stratified-IS math compensates unequal sampleable mass
