@@ -155,6 +155,98 @@ class SignalAtari:
         return self._frame(), reward, done, done
 
 
+class VelocitySignalAtari:
+    """Pixel env whose reward is a function of MOTION, not appearance — the
+    temporal-integration probe (VERDICT r3 next #9).
+
+    One bright band drifts across the screen with a velocity drawn from
+    ``num_actions`` distinct values; acting with the velocity's index pays
+    +1. The band's POSITION is redrawn uniformly at every segment start,
+    independent of the velocity, so a single frame carries zero reward
+    information — Q* is constant over single frames. Beating random
+    requires comparing at least two consecutive frames: the frame-stack
+    path must read displacement across stack channels, and the stack=1
+    recurrent path must carry the previous position in LSTM state. That is
+    exactly the capability ``SignalAtari`` (static band ⇒ single-frame
+    pattern matching) cannot test.
+
+    Velocity changes every ``segment`` steps (with a fresh position), so
+    ~1/segment of steps — plus the first step after reset, when the stack
+    holds no prior same-segment frame — are unreadable even for a perfect
+    decoder; the achievable ceiling is ≈ (1 - 1/segment) + 1/(segment·A)
+    reward per step (~0.91 at segment=8, A=4) vs the 1/A = 0.25 random
+    floor.
+
+    Orientation "v": vertical band (spans all rows) drifting horizontally;
+    "h": horizontal band drifting vertically — two distinct "games" for
+    multi-game fleets, like SignalAtari's pair.
+    """
+
+    def __init__(self, episode_len: int = 32, num_actions: int = 4,
+                 frame_shape: tuple[int, int] = (84, 84), seed: int = 0,
+                 orientation: str = "v", segment: int = 8):
+        """``segment=0`` holds the velocity for the WHOLE episode (only the
+        reset redraws) — the easiest memory variant: read the motion once,
+        carry the answer. Positive ``segment`` redraws velocity+position
+        every that many steps."""
+        assert orientation in ("v", "h")
+        self.episode_len = int(episode_len)
+        self.num_actions = int(num_actions)
+        self.obs_shape = tuple(frame_shape)
+        self.obs_dtype = np.uint8
+        self.orientation = orientation
+        self.segment = int(segment) if segment else self.episode_len + 1
+        h, w = frame_shape
+        self._axis = w if orientation == "v" else h
+        self.band_width = max(3, self._axis // 8)
+        # symmetric speeds, zero excluded (a parked band needs no temporal
+        # integration to identify — it would reintroduce the single-frame
+        # shortcut this env exists to remove): A=4 → (-2, -1, 1, 2) × u px
+        u = max(2, self._axis // 16)
+        half = self.num_actions // 2
+        units = list(range(-half, 0)) + \
+            list(range(1, self.num_actions - half + 1))
+        self.velocities = tuple(int(u * m) for m in units)
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._v_idx = 0
+        self._pos = 0
+
+    def _redraw(self) -> None:
+        self._v_idx = int(self._rng.integers(self.num_actions))
+        self._pos = int(self._rng.integers(self._axis))
+
+    def _frame(self) -> np.ndarray:
+        f = np.full(self.obs_shape, 20, np.uint8)
+        idx = (self._pos + np.arange(self.band_width)) % self._axis
+        if self.orientation == "v":
+            f[:, idx] = 220
+        else:
+            f[idx, :] = 220
+        return f
+
+    def reset(self) -> np.ndarray:
+        self._t = 0
+        self._redraw()
+        return self._frame()
+
+    def step(self, action: int):
+        # reward keys on the velocity in effect over the frames the agent
+        # just observed
+        reward = 1.0 if int(action) == self._v_idx else 0.0
+        self._t += 1
+        if self._t % self.segment == 0:
+            self._redraw()      # fresh velocity AND position: the new
+            #                     position is independent of both the old
+            #                     and new velocity, so boundary frames leak
+            #                     nothing
+        else:
+            self._pos = (self._pos + self.velocities[self._v_idx]) \
+                % self._axis
+        done = self._t >= self.episode_len
+        return self._frame(), reward, done, done
+
+
 # ---------------------------------------------------------------------------
 # Atari (ALE) with canonical DQN preprocessing
 # ---------------------------------------------------------------------------
@@ -275,9 +367,17 @@ def make_env(cfg: EnvConfig, seed: int = 0) -> Env:
         return FakeAtari(frame_shape=cfg.frame_shape)
     if cfg.kind == "signal_atari":
         # id "signal" = vertical bands, "signal-h" = horizontal — two
-        # distinct fake "games" for multi-game fleet tests
+        # distinct fake "games" for multi-game fleet tests; the "-vel"
+        # ids select the moving-band temporal-integration variant
+        orientation = "h" if cfg.id.endswith("-h") else "v"
+        if "-vel" in cfg.id:
+            # "-ep" holds velocity for the whole episode (memory-gate
+            # difficulty tier); default redraws every 8 steps
+            return VelocitySignalAtari(frame_shape=cfg.frame_shape,
+                                       seed=seed, orientation=orientation,
+                                       segment=0 if "-ep" in cfg.id else 8)
         return SignalAtari(frame_shape=cfg.frame_shape, seed=seed,
-                           orientation="h" if cfg.id.endswith("-h") else "v")
+                           orientation=orientation)
     raise ValueError(f"unknown env kind {cfg.kind!r}")
 
 

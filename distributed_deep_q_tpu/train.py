@@ -368,6 +368,12 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
     from distributed_deep_q_tpu.replay.sequence import (
         SequenceBuilder, SequenceReplay)
 
+    if cfg.replay.persist_path:
+        raise ValueError(
+            "replay.persist_path covers the transition-replay paths "
+            "(train_single_process); sequence replays have no serializer "
+            "yet — unset it for R2D2 runs (warm refill, the reference "
+            "default, applies)")
     metrics = metrics or Metrics()
     env = make_env(cfg.env, seed=cfg.train.seed)
     cfg.net.num_actions = env.num_actions

@@ -157,6 +157,57 @@ def test_actor_heartbeats_without_data_traffic():
         server.close()
 
 
+def test_heartbeats_survive_a_blocking_env_step(monkeypatch):
+    """Liveness must be independent of env stepping: the beat runs on its
+    own thread, so an actor stuck INSIDE one long ``env.step()`` (emulator
+    hiccup, remote env stall) keeps its stamp fresh instead of being
+    respawned mid-stall."""
+    import distributed_deep_q_tpu.actors.game as game
+    from distributed_deep_q_tpu.actors.supervisor import actor_main
+    from distributed_deep_q_tpu.config import cartpole_config
+
+    class StallEnv:
+        num_actions = 2
+        obs_shape = (4,)
+        obs_dtype = np.float32
+
+        def reset(self):
+            return np.zeros(4, np.float32)
+
+        def step(self, action):
+            time.sleep(0.8)  # one env step ≫ many heartbeat periods
+            return np.zeros(4, np.float32), 0.0, False, False
+
+    monkeypatch.setattr(game, "make_env", lambda *a, **k: StallEnv())
+    cfg = cartpole_config()
+    cfg.actors.send_batch = 10**9
+    cfg.actors.param_sync_period = 10**9
+    cfg.actors.heartbeat_period = 0.05
+    server = ReplayFeedServer(ReplayMemory(256, (4,), np.float32))
+    host, port = server.address
+    stop = threading.Event()
+    t = threading.Thread(target=actor_main,
+                         args=(cfg, host, port, 0, stop), daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 30
+        while 0 not in server.last_seen and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert 0 in server.last_seen, "actor never reached the server"
+        stamps = set()
+        while len(stamps) < 4 and time.monotonic() < deadline:
+            stamps.add(server.last_seen[0])
+            time.sleep(0.05)
+        # ≥4 distinct stamps in < a couple of env steps: beats flowed
+        # while the loop was blocked inside step()
+        assert len(stamps) >= 4, \
+            "liveness stamp froze during an in-step stall"
+    finally:
+        stop.set()
+        t.join(timeout=20)
+        server.close()
+
+
 @pytest.mark.slow
 def test_distributed_cartpole_end_to_end():
     """Full topology on loopback: 2 actor processes + learner, vector env."""
