@@ -172,6 +172,11 @@ class ActorConfig:
     # actor in a slow env can legitimately go > heartbeat_timeout without
     # filling a send_batch (VERDICT r3 weak #5)
     heartbeat_period: float = 5.0
+    # the beat stops once the env loop has made no progress for this many
+    # wall-clock seconds, so a PERMANENTLY wedged env still trips the
+    # supervisor's heartbeat_timeout and gets respawned — this budget is
+    # the line between "slow step, keep alive" and "hung, replace"
+    env_stall_budget: float = 300.0
     # transitions per RPC AddTransitions message
     send_batch: int = 64
     # replay-feed service address

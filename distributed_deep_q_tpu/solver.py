@@ -197,3 +197,59 @@ class Solver:
         self.state = self.state.replace(params=params)
 
     set_weights = update
+
+
+class FusedStepStream:
+    """Per-grad-step metrics from chained fused-PER dispatches.
+
+    Both train loops consume the fused path one GRAD STEP at a time (their
+    bookkeeping — priority write-back cadence, checkpoints, logging — is
+    per-step), while the device runs ``chain`` scanned steps per dispatch.
+    This owns the bridge in ONE place: dispatch a chunk of
+    ``min(chain, steps_left)`` steps whenever the previous chunk is
+    exhausted (the tail clamp keeps the optimizer-step total exact), then
+    hand out the chunk's stacked metrics row by row. The slicing index is
+    easy to get subtly wrong in hand-maintained copies — an off-by-one
+    would attribute metrics to the neighboring grad step.
+
+    ``dispatch_lock`` (optional context manager, e.g. the ReplayFeed
+    server's ``replay_lock``) is held across the dispatch only — the
+    donated device state must not be swapped mid-dispatch, but writers get
+    the window while the chunk executes on device. ``timer`` is the train
+    loop's ``StepTimer`` (dispatch phase attribution).
+    """
+
+    def __init__(self, solver: Solver, replay, chain: int,
+                 dispatch_lock=None, timer=None):
+        import contextlib
+
+        self._solver = solver
+        self._replay = replay
+        self.chain = max(int(chain), 1)
+        self._lock = dispatch_lock or contextlib.nullcontext()
+        self._timer = timer
+        self._chunk: dict[str, Any] | None = None
+        self._len = 0
+        self._pending = 0
+
+    def next(self, steps_left: int) -> dict[str, Any]:
+        """Metrics for one grad step; dispatches a fresh chunk as needed.
+
+        ``steps_left`` counts THIS step: the final partial chunk compiles
+        one extra (smaller) program pair — pick totals divisible by
+        ``fused_chain`` to avoid it.
+        """
+        if self._pending == 0:
+            import contextlib
+
+            self._len = min(self.chain, max(int(steps_left), 1))
+            phase = (self._timer.phase("dispatch") if self._timer
+                     else contextlib.nullcontext())
+            with self._lock, phase:
+                self._chunk = self._solver.train_steps_device_per(
+                    self._replay, chain=self._len)
+            self._pending = self._len
+        m = {k: v[self._len - self._pending]
+             for k, v in self._chunk.items()}
+        self._pending -= 1
+        return m

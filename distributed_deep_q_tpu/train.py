@@ -164,6 +164,7 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
     ep_ret, ep_returns = 0.0, MovingAverage(100)
     summary: dict = {}
     from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
+    from distributed_deep_q_tpu.solver import FusedStepStream
     fused_per = isinstance(replay, DevicePERFrameReplay)
     writeback = None
     if replay.prioritized and not fused_per:
@@ -174,6 +175,8 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
     gsteps = 0
     best_eval, best_params = float("-inf"), None
     timer = StepTimer()
+    fused_stream = (FusedStepStream(solver, replay, cfg.replay.fused_chain,
+                                    timer=timer) if fused_per else None)
     trace = TraceWindow(cfg.train.profile_dir, cfg.train.profile_start_step,
                         cfg.train.profile_num_steps)
     if cfg.train.profile_port:
@@ -239,25 +242,13 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
                 # Fused path: chain up to fused_chain of the j steps into one
                 # two-program dispatch (lax.scan); per-step bookkeeping below
                 # reads its row of the chunk's stacked metrics.
-                chain = (min(max(cfg.replay.fused_chain, 1),
-                             cfg.train.grad_steps_per_train)
-                         if fused_per else 1)
-                pending = chunk_len = 0
                 for j in range(cfg.train.grad_steps_per_train):
                     if fused_per:
-                        # sample+train+priority-update fused on device;
-                        # the tail chunk clamps to the steps actually left
-                        # so the device never applies extra optimizer steps
-                        if pending == 0:
-                            chunk_len = min(
-                                chain, cfg.train.grad_steps_per_train - j)
-                            with timer.phase("dispatch"):
-                                mk = solver.train_steps_device_per(
-                                    replay, chain=chunk_len)
-                            pending = chunk_len
-                        m = {k: v[chunk_len - pending]
-                             for k, v in mk.items()}
-                        pending -= 1
+                        # sample+train+priority-update fused on device,
+                        # up to fused_chain grad steps per dispatch
+                        # (FusedStepStream owns the chunk/tail/slicing)
+                        m = fused_stream.next(
+                            cfg.train.grad_steps_per_train - j)
                     else:
                         with timer.phase("sample"):
                             batch = replay.sample(local_batch)

@@ -27,16 +27,18 @@ Run on the TPU box:  python scripts/mfu_breakdown.py
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 REPS = 5
-PEAK_BF16 = {  # bf16 peak FLOP/s (same table as bench.py)
-    "TPU v6 lite": 918e12, "TPU v5 lite": 197e12, "TPU v5": 459e12,
-    "TPU v4": 275e12, "TPU v3": 123e12,
-}
-HBM_GBPS = {  # public per-chip HBM bandwidth, GB/s
+HBM_GBPS = {  # public per-chip HBM bandwidth, GB/s (keys match
+    #           bench.PEAK_FLOPS — the flops side lives there)
     "TPU v6 lite": 1640.0, "TPU v5 lite": 819.0, "TPU v5": 2765.0,
     "TPU v4": 1228.0, "TPU v3": 900.0,
 }
@@ -86,13 +88,8 @@ def cost_of(lowered) -> dict:
 
 
 def build(batch: int, capacity: int = 65_536):
-    import os
-    import sys
-
     import jax
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
     from bench import build as bench_build
     from distributed_deep_q_tpu import config as cfg_mod
 
@@ -115,11 +112,13 @@ def main() -> None:
         jax.config.update("jax_num_cpu_devices", 8)
     import jax.numpy as jnp
 
+    from bench import peak_flops_for
+
     on_cpu = jax.devices()[0].platform == "cpu"
     iters = 20 if on_cpu else 400
     out: dict = {"device_kind": getattr(jax.devices()[0], "device_kind",
                                         jax.devices()[0].platform)}
-    peak = lookup(PEAK_BF16, out["device_kind"])
+    peak = peak_flops_for(jax.devices()[0])
     hbm = lookup(HBM_GBPS, out["device_kind"])
 
     solver, replay = build(512)

@@ -208,6 +208,58 @@ def test_heartbeats_survive_a_blocking_env_step(monkeypatch):
         server.close()
 
 
+def test_beat_goes_silent_past_the_stall_budget(monkeypatch):
+    """The flip side of the stall tolerance: once the env loop makes no
+    progress for longer than ``env_stall_budget``, the beat must STOP, so
+    a permanently wedged env still trips the supervisor's
+    heartbeat_timeout and gets replaced (hang detection survives the
+    thread-backed beat)."""
+    import distributed_deep_q_tpu.actors.game as game
+    from distributed_deep_q_tpu.actors.supervisor import actor_main
+    from distributed_deep_q_tpu.config import cartpole_config
+
+    class HungEnv:
+        num_actions = 2
+        obs_shape = (4,)
+        obs_dtype = np.float32
+
+        def reset(self):
+            return np.zeros(4, np.float32)
+
+        def step(self, action):
+            time.sleep(600)  # wedged beyond any budget in this test
+            return np.zeros(4, np.float32), 0.0, False, False
+
+    monkeypatch.setattr(game, "make_env", lambda *a, **k: HungEnv())
+    cfg = cartpole_config()
+    cfg.actors.send_batch = 10**9
+    cfg.actors.param_sync_period = 10**9
+    cfg.actors.heartbeat_period = 0.05
+    cfg.actors.env_stall_budget = 0.5
+    server = ReplayFeedServer(ReplayMemory(256, (4,), np.float32))
+    host, port = server.address
+    stop = threading.Event()
+    t = threading.Thread(target=actor_main,
+                         args=(cfg, host, port, 0, stop), daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 30
+        while 0 not in server.last_seen and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert 0 in server.last_seen, "actor never reached the server"
+        # wait out the budget, then the stamp must freeze
+        time.sleep(cfg.actors.env_stall_budget + 0.3)
+        frozen = server.last_seen[0]
+        time.sleep(0.5)  # ≥ several heartbeat periods
+        assert server.last_seen[0] == frozen, \
+            "beat kept flowing past the stall budget — hung actors would " \
+            "never be respawned"
+    finally:
+        stop.set()
+        server.close()  # the actor thread stays parked in its hung step;
+        #                 it's a daemon, the interpreter reaps it at exit
+
+
 @pytest.mark.slow
 def test_distributed_cartpole_end_to_end():
     """Full topology on loopback: 2 actor processes + learner, vector env."""
