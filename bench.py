@@ -12,14 +12,18 @@ Variants (all timed in one run, all keys on the ONE output line):
 - **flagship** — the headline: DEVICE-RESIDENT PER (replay/device_per.py:
   priorities + metadata in HBM, sampling/composition/priority-update
   fused into the step, zero per-step D2H), 1M-frame ring capacity
-  (config 2-4's `replay.capacity=1_000_000`), batch 512, and CONCURRENT
-  actor writes: 4 writer threads stream transition chunks through
-  ``add_batch`` under the same lock discipline the distributed supervisor
-  uses (lock held across dispatch, released while the device step runs),
-  while the learner loop runs fused steps. Writers are PACED to a
-  combined 16,384 transitions/s (≈256 Ape-X actors at 64 env-steps/s
-  each) — unthrottled writers measure Python lock starvation, not the
-  production regime, where actors emit at env rate.
+  (config 2-4's `replay.capacity=1_000_000`), batch 512, fused chained
+  dispatch, measured with the learner running free after warm fill —
+  the learner's own honest rate on the production shape.
+  ``flagship_under_ingest_steps_per_s`` re-measures the SAME learner
+  with 4 concurrent writer threads streaming transition chunks through
+  ``add_batch`` under the distributed supervisor's lock discipline,
+  paced to a combined 1,024 transitions/s (≈16 Ape-X actors at 64
+  env-steps/s) with backpressure on staged-but-unflushed rows.
+  On this container the shared tunnel link — not the learner — sets the
+  under-ingest rate (even ~29 MB/s of pixels saturates it, and an
+  unthrottled writer backlog OOM-killed the host at 130 GB RSS), which
+  is why it is a separate key rather than the headline.
   ``ingest_transitions_per_s`` is the concurrently-ACHIEVED ingest in
   the measurement window (reported, not assumed). Host-tree PER remains
   the CPU/fallback path; on this hardware its per-step |TD| readback
@@ -54,7 +58,10 @@ Variants (all timed in one run, all keys on the ONE output line):
   hand-written fused TD-loss kernel (ops/pallas_kernels.py) vs XLA fusion
   (pallas_off == idle_uniform, same program otherwise). Reported so the
   kernel's TPU benefit is measured, not asserted; ``null`` if the kernel
-  fails to compile on this platform.
+  fails to compile on this platform. NOTE: with honest fencing both
+  sides of this comparison are bound by the tunnel's per-dispatch drain,
+  so the loss-kernel delta is invisible here — the kernel is formally a
+  correctness demonstrator, not a perf claim (PERF.md).
 
 Baseline normalization — THREE ratios, all printed:
 
@@ -79,13 +86,15 @@ MFU derivation (printed as ``mfu`` plus the inputs):
   FC 2·3136·512 + heads ≈ 3.3 MF → ≈18.8 MF/sample forward. Train step =
   online fwd+bwd (≈3× fwd) + target fwd + Double-DQN online fwd on s' =
   ≈5× fwd ≈ 94 MF/sample → ≈48 GFLOP/step at B=512.
-- ``mfu`` = flops_per_step × idle_uniform_steps_per_s / peak_flops for
-  the detected chip (bf16 peak: v5 lite 197 TF/s, v4 275, v3 123, v6
-  lite 918); null on unknown hardware. MFU uses the IDLE rate — it
-  characterizes the compiled step's device utilization; the flagship
-  rate includes host-side ingest contention, which is a systems number,
-  not a compute-efficiency one. The torso runs bf16 (MXU path); the
-  fp32 head/loss/optimizer tail makes this a conservative estimate.
+- ``mfu`` = flops_per_step / in_scan_step / peak_flops for the detected
+  chip (bf16 peak: v5 lite 197 TF/s, v4 275, v3 123, v6 lite 918); null
+  on unknown hardware. MFU uses ``in_scan_step_ms_b512`` — the per-step
+  device time INSIDE a chained chunk, separated from the tunnel's fixed
+  per-dispatch drain via two chain lengths — because any per-dispatch
+  rate on this runtime measures the tunnel, not the chip. The measured
+  step is HBM-bound (~0.68 GB accessed/step at batch 512 per XLA's
+  compiled cost analysis — fwd+bwd activation traffic), which is where
+  the non-MXU time goes; see PERF.md.
 
 Run-to-run variance: every variant is timed as REPS repetitions;
 reported value is the MEDIAN rep rate, and ``flagship_spread`` =
@@ -95,6 +104,19 @@ silent. Round 4 attacks the r3 spread (20.7%) three ways: 5 reps
 instead of 3 (median robust to one contended-chip outlier), ~4× longer
 reps (≥1 s of steps each), and chained dispatch (fewer host↔device
 round trips per rep ⇒ less tunnel-jitter exposure).
+
+Synchronization (round 4 finding): on this tunneled TPU runtime
+``jax.block_until_ready`` signals ENQUEUE, not completion — 50 chained
+8192³ bf16 matmuls report "ready" in 1.6 ms (≈34 PF/s, impossible on
+one chip), while forcing a D2H read gives ~125-160 TF/s, consistent
+with the chip's 197 TF/s peak. Any loop that ends with
+``block_until_ready`` therefore measures host dispatch throughput
+whenever enqueue outpaces the device (chained/scanned dispatches
+especially). Every timed window here ends with ``_fence`` — a D2H read
+of ``state.step``, which data-depends on every dispatched step through
+the donated-state chain — and per-rep rates subtract the separately
+measured fence RTT (``fence_rtt_ms``, reported) so the fence itself
+doesn't bias long reps.
 
 Prints ONE JSON line, e.g.:
   {"metric": "learner_grad_steps_per_sec", "value": <flagship>,
@@ -113,8 +135,27 @@ BATCH = 512
 CAFFE_STEPS_PER_S = 100.0            # documented estimate, batch 32
 CAFFE_TRANSITIONS_PER_S = 3200.0     # = 100 steps/s * batch 32
 REPS = 5
-CHAIN = 8                            # fused_chain: grad steps per dispatch
-INGEST_TARGET = 16_384               # combined actor-rate t/s, flagship
+# fused_chain for the benched fused variants. The tunnel serializes
+# dispatch drains at ~7-18 ms per program call (measured, constant in
+# chain length), so throughput = chain / (fixed + chain · in-scan step):
+# chain=64 puts the flagship within ~10% of its in-scan asymptote;
+# chain=256 does the same for the cheaper batch-32 step. Within-chunk
+# priority staleness ≤ chain — a real tradeoff, stated, not hidden
+# (production default stays replay.fused_chain=8; these are the
+# throughput-mode settings a user can pick with one config field).
+CHAIN = 64
+B32_CHAIN = 256
+# combined actor-rate ingest during the flagship window. 16k t/s of
+# 84×84 frames is ~113 MB/s of pixels: beyond what this container's
+# tunneled H2D link sustains alongside the program stream (~180 MB/s
+# total, and every staged-but-undrained buffer is host RSS — an
+# unbounded writer OOM-killed the host at 130 GB). Even 4k t/s ≈ 29 MB/s
+# saturates the shared link (measured: the fenced learner collapsed to
+# 34 steps/s, i.e. the variant measured the tunnel, not the learner);
+# 1k t/s ≈ 7 MB/s leaves program-stream headroom.
+# ``ingest_transitions_per_s`` reports what was ACHIEVED.
+INGEST_TARGET = 1_024
+REP_TARGET_S = 1.0                   # auto-size iters ≈ this much work/rep
 
 # bf16 peak FLOP/s by device_kind prefix (public spec sheets)
 PEAK_FLOPS = {
@@ -222,6 +263,35 @@ def build(cfg_mod, *, capacity: int, batch: int, prioritized: bool,
     return solver, replay
 
 
+def _fence(solver) -> int:
+    """TRUE device sync: D2H-read ``state.step``, which depends on every
+    dispatched step via the donated-state chain. ``block_until_ready`` is
+    NOT a fence on this tunneled runtime (see module docstring)."""
+    import jax
+
+    return int(jax.device_get(solver.state.step))
+
+
+def _fence_rtt(solver, reps: int = 3) -> float:
+    """Median cost of a FIRST D2H read of a fresh, already-drained device
+    scalar — the pure tunnel round trip a rep's closing fence pays on top
+    of waiting for the work. Each probe dispatches a fresh value (a
+    re-read of a fetched array hits jax's host-side cache and measures
+    ~0.1 ms instead of the ~1 ms tunnel RTT), then sleeps it to
+    completion so no drain time pollutes the read."""
+    import jax
+
+    _fence(solver)
+    costs = []
+    for _ in range(reps):
+        fresh = solver.state.step + 1  # tiny dispatch, fresh buffer
+        time.sleep(0.25)               # drained before the timed read
+        t0 = time.perf_counter()
+        int(jax.device_get(fresh))
+        costs.append(time.perf_counter() - t0)
+    return float(np.median(costs))
+
+
 def time_variant(solver, replay, batch: int, iters: int, warmup: int,
                  lock: threading.Lock | None = None,
                  on_warm=None, chain: int = 1) -> list[float]:
@@ -270,7 +340,18 @@ def time_variant(solver, replay, batch: int, iters: int, warmup: int,
 
     for _ in range(warmup):
         one_step()
-    jax.block_until_ready(solver.state.params)
+    _fence(solver)
+    rtt = _fence_rtt(solver)
+    # auto-size the rep so every variant measures ~REP_TARGET_S of real
+    # (fenced) work — honest rates vary ~50× between the chained fused
+    # path and a per-step-dispatch variant on this tunnel, so one static
+    # iters either wastes minutes or measures noise
+    t0 = time.perf_counter()
+    for _ in range(max(iters // 16, 2)):
+        one_step()
+    _fence(solver)
+    probe = (time.perf_counter() - t0) / max(iters // 16, 2)
+    iters = max(int(REP_TARGET_S / max(probe, 1e-9)), 4)
     if on_warm is not None:
         on_warm()  # timing windows must exclude compile+warmup
 
@@ -279,8 +360,9 @@ def time_variant(solver, replay, batch: int, iters: int, warmup: int,
         t0 = time.perf_counter()
         for _ in range(iters):
             one_step()
-        jax.block_until_ready(solver.state.params)
-        rates.append(iters * chain / (time.perf_counter() - t0))
+        _fence(solver)  # completion, not enqueue (module docstring)
+        elapsed = max(time.perf_counter() - t0 - rtt, 1e-9)
+        rates.append(iters * chain / elapsed)
     return rates
 
 
@@ -304,6 +386,12 @@ def run_writers(replay, lock: threading.Lock, stop: threading.Event,
             delay = next_due - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
+            # backpressure: staged rows the learner hasn't flushed yet are
+            # host RSS — bound them instead of growing without limit while
+            # the learner compiles or drains a fenced rep
+            while (sum(replay._pending_rows) > 32_768
+                   and not stop.is_set()):
+                time.sleep(0.005)
             done = np.zeros(chunk, bool)
             done[-1] = (t % 10 == 9)  # an episode boundary every ~10 chunks
             payload = {"frame": frames, "action": np.zeros(chunk, np.int32),
@@ -341,7 +429,9 @@ def bench_r2d2(cfg_mod, on_cpu: bool, out: dict) -> None:
         n_seqs, iters_host, iters_dev, reps = 64, 3, 6, 2
     else:
         hw, stack, seq_len, burn, batch, lstm = (84, 84), 4, 80, 40, 64, 512
-        n_seqs, iters_host, iters_dev, reps = 512, 12, 60, 3
+        # host-store steps ship ~36 MB H2D each — honestly fenced that is
+        # ~11 s/step on this link, so a handful of iters says it all
+        n_seqs, iters_host, iters_dev, reps = 512, 3, 60, 2
 
     cfg = cfg_mod.Config()
     cfg.net = cfg_mod.NetConfig(kind="r2d2", num_actions=6, frame_shape=hw,
@@ -377,14 +467,15 @@ def bench_r2d2(cfg_mod, on_cpu: bool, out: dict) -> None:
     def time_loop(step_fn, iters):
         for _ in range(3):
             step_fn()
-        jax.block_until_ready(solver.state.params)
+        _fence(solver)
+        rtt = _fence_rtt(solver)
         rates = []
         for _ in range(reps):
             t0 = time.perf_counter()
             for _ in range(iters):
                 step_fn()
-            jax.block_until_ready(solver.state.params)
-            rates.append(iters / (time.perf_counter() - t0))
+            _fence(solver)  # completion, not enqueue
+            rates.append(iters / max(time.perf_counter() - t0 - rtt, 1e-9))
         return float(np.median(rates))
 
     host = SequenceReplay(n_seqs, seq_len, obs_shape, np.uint8, lstm)
@@ -420,30 +511,51 @@ def bench_r2d2(cfg_mod, on_cpu: bool, out: dict) -> None:
 def main() -> None:
     import jax
 
+    # persistent compile cache: the five distinct fused program pairs
+    # dominate a cold run (~minutes each on this host); the driver runs
+    # this bench repeatedly and should pay them once
+    import os
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.abspath(
+                          __file__)), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
     from distributed_deep_q_tpu import config as cfg_mod
 
     on_cpu = jax.devices()[0].platform == "cpu"
     # CPU fallback sizes keep local runs tractable; the driver runs on TPU
     # with the full flagship shapes.
     flag_cap = 131_072 if on_cpu else 1_000_000
-    flag_prefill = 20_000 if on_cpu else 100_000
+    flag_prefill = 20_000 if on_cpu else 60_000
     idle_prefill = 20_000 if on_cpu else 40_000
-    # rep sizing (r4): ≥ ~0.5-1 s of steps per rep — short reps measure
-    # tunnel/host jitter, not the learner (the r3 flagship_spread=0.21
-    # driver). Chained variants count iters in CHUNKS of CHAIN steps.
-    iters = 20 if on_cpu else 1000
-    chunks = 4 if on_cpu else 200
-    warmup = 5 if on_cpu else 20
+    # rep sizing (r4): time_variant auto-sizes each rep to ~REP_TARGET_S
+    # of FENCED work (honest rates span ~50× between variants on this
+    # tunnel); the iters passed below only sizes the calibration probe.
+    iters = 20 if on_cpu else 400
+    chunks = 4 if on_cpu else 64
+    warmup = 3 if on_cpu else 10
     writers = 4
+    # chain lengths: full on TPU (amortize the tunnel's per-dispatch
+    # drain), tiny on the CPU smoke (a 256-long scan per dispatch makes
+    # the 1-core fallback run take tens of minutes for no extra signal)
+    chain = 4 if on_cpu else CHAIN
+    b32_chain = 8 if on_cpu else B32_CHAIN
+
+    import sys
+
+    def note(msg):
+        print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
     out: dict = {}
 
+    note("idle_uniform")
     # -- idle_uniform (r1/r2-comparable) + MFU inputs + pallas ------------
     solver, replay = build(cfg_mod, capacity=65_536, batch=BATCH,
                            prioritized=False, pallas=False,
                            prefill=idle_prefill)
     probe = replay.sample(BATCH)
     probe.pop("_sampled_at", None)
+    out["fence_rtt_ms"] = round(1e3 * _fence_rtt(solver), 2)
     rates = time_variant(solver, replay, BATCH, iters // 2, warmup)
     idle = float(np.median(rates))
     out["idle_uniform_steps_per_s"] = round(idle, 2)
@@ -455,23 +567,55 @@ def main() -> None:
     out["flops_per_step_analytic"] = analytic_flops_per_step(BATCH)
     del solver, replay
 
+    note("idle_fused")
+    # -- idle fused (batch 512): MFU basis + the chain asymptote ----------
+    # The per-chunk fixed cost F (tunnel dispatch drain) and the in-scan
+    # per-step device time s separate via two chain lengths: with
+    # t_c = 1/rate_c per step, s = (t2·c2 − t1·c1)/(c2 − c1).
+    # MFU is computed against s — the actual device step — not against a
+    # launch-bound per-dispatch rate. TPU only: MFU needs a known chip
+    # peak (None on CPU), and the chained batch-512 compiles alone take
+    # tens of minutes on the 1-core CPU fallback.
+    if on_cpu:
+        out["idle_fused_steps_per_s"] = None
+        out["in_scan_step_ms_b512"] = None
+        out["chunk_fixed_ms"] = None
+    else:
+        solver, replay = build(cfg_mod, capacity=65_536, batch=BATCH,
+                               prioritized=True, pallas=False,
+                               device_per=True, prefill=idle_prefill)
+        c1, c2 = CHAIN, B32_CHAIN
+        r1 = float(np.median(time_variant(solver, replay, BATCH, chunks,
+                                          warmup, chain=c1)))
+        r2 = float(np.median(time_variant(solver, replay, BATCH, chunks,
+                                          warmup, chain=c2)))
+        t1, t2 = 1.0 / r1, 1.0 / r2
+        s = max((t2 * c2 - t1 * c1) / (c2 - c1), 1e-9)
+        out["idle_fused_steps_per_s"] = round(max(r1, r2), 2)
+        out["idle_fused_chain_k"] = c1 if r1 >= r2 else c2
+        out["in_scan_step_ms_b512"] = round(1e3 * s, 4)
+        out["chunk_fixed_ms"] = round(1e3 * max(t1 - s, 0.0) * c1, 2)
+        del solver, replay
+
+    note("batch32")
     # -- batch32: matched-batch north star, production fused path ---------
     solver, replay = build(cfg_mod, capacity=65_536, batch=32,
                            prioritized=True, pallas=False, device_per=True,
                            prefill=idle_prefill)
     rates32 = time_variant(solver, replay, 32, chunks * 4, warmup,
-                           chain=CHAIN)
+                           chain=b32_chain)
     b32 = float(np.median(rates32))
     out["batch32_steps_per_s"] = round(b32, 2)
     out["batch32_vs_baseline"] = round(b32 / CAFFE_STEPS_PER_S, 2)
     out["batch32_spread"] = round((max(rates32) - min(rates32)) / b32, 4)
-    out["batch32_chain_k"] = CHAIN
+    out["batch32_chain_k"] = b32_chain
     out["batch32_per"] = "device_fused"
     rates32u = time_variant(solver, replay, 32, iters, warmup, chain=1)
     out["batch32_single_dispatch_steps_per_s"] = \
         round(float(np.median(rates32u)), 2)
     del solver, replay
 
+    note("pallas")
     psolver, preplay = build(cfg_mod, capacity=65_536, batch=BATCH,
                              prioritized=False, pallas=True,
                              prefill=idle_prefill)
@@ -484,35 +628,56 @@ def main() -> None:
     del psolver, preplay  # free the 65k ring before the 1M allocation
     out["pallas_off_steps_per_s"] = out["idle_uniform_steps_per_s"]
 
+    note("r2d2")
     # -- r2d2 pixel path: host store vs device sequence ring --------------
     bench_r2d2(cfg_mod, on_cpu, out)
 
+    note("flagship")
     # -- flagship: PER + 1M ring + concurrent actor ingest ----------------
-    solver, replay = build(cfg_mod, capacity=flag_cap, batch=BATCH,
+    flag_batch = 128 if on_cpu else BATCH  # chained b512 compiles are
+    #                                        impractical on the CPU smoke
+    # chunk pixel staging is chain·B·stack·HW·2 bytes next to the 7 GB
+    # 1M-frame ring: chain=64 OOMs a 16 GB chip (3.7 GB staged), 32 fits
+    flag_chain = chain if on_cpu else min(chain, 32)
+    solver, replay = build(cfg_mod, capacity=flag_cap, batch=flag_batch,
                            prioritized=True, pallas=False, device_per=True,
                            num_streams=writers, prefill=flag_prefill)
+    # (a) the HEADLINE: production shape (1M ring, fused chained),
+    # learner running free after warm fill — the learner's own rate
+    rates = time_variant(solver, replay, flag_batch, chunks, warmup,
+                         chain=flag_chain)
+    flagship = float(np.median(rates))
+    out["flagship_spread"] = round((max(rates) - min(rates)) / flagship, 4)
+    out["flagship_chain_k"] = flag_chain
+
+    # (b) the same learner with concurrent paced actor ingest — on this
+    # container the shared tunnel link (not the learner) sets this rate,
+    # so it is reported as its own key, with the ACHIEVED ingest
     lock = threading.Lock()
     stop = threading.Event()
     counter = [0] * writers
-    run_writers(replay, lock, stop, counter, writers)
     window = {}
 
     def mark_warm():
-        # exclude the fused-step compile + warmup (run under the lock)
-        # from the achieved-ingest window
+        # writers start only now — streaming through compile/warmup would
+        # pile staged frames into host RSS for nothing (and the ingest
+        # window must exclude compile anyway)
+        run_writers(replay, lock, stop, counter, writers)
         window["t0"] = time.perf_counter()
         window["c0"] = sum(counter)
 
-    rates = time_variant(solver, replay, BATCH, chunks, warmup, lock=lock,
-                         on_warm=mark_warm, chain=CHAIN)
+    irates = time_variant(solver, replay, flag_batch, chunks, 2,
+                          lock=lock, on_warm=mark_warm, chain=flag_chain)
     ingest = ((sum(counter) - window["c0"])
               / (time.perf_counter() - window["t0"]))
     stop.set()
-    flagship = float(np.median(rates))
-    out["flagship_spread"] = round((max(rates) - min(rates)) / flagship, 4)
-    out["flagship_chain_k"] = CHAIN
+    under = float(np.median(irates))
+    out["flagship_under_ingest_steps_per_s"] = round(under, 2)
+    out["under_ingest_spread"] = round((max(irates) - min(irates))
+                                       / under, 4)
     out["ingest_transitions_per_s"] = round(ingest, 1)
     out["ring_capacity_frames"] = replay.capacity
+    out["flagship_batch"] = flag_batch
     out["prioritized"] = True
     out["flagship_per"] = "device_fused"  # replay/device_per.py
     out["concurrent_writers"] = writers
@@ -522,16 +687,25 @@ def main() -> None:
     peak = peak_flops_for(dev)
     out["device_kind"] = getattr(dev, "device_kind", dev.platform)
     out["peak_flops_bf16"] = peak
-    out["tflops_per_s"] = round(out["flops_per_step"] * idle / 1e12, 2)
-    out["mfu"] = (round(out["flops_per_step"] * idle / peak, 4)
-                  if peak else None)
+    # MFU against the in-scan device step (s) — the launch-bound idle
+    # rate would measure the tunnel, not the chip
+    if out["in_scan_step_ms_b512"]:
+        in_scan_rate = 1e3 / out["in_scan_step_ms_b512"]
+        out["tflops_per_s"] = round(out["flops_per_step"] * in_scan_rate
+                                    / 1e12, 2)
+        out["mfu"] = (round(out["flops_per_step"] * in_scan_rate / peak, 4)
+                      if peak else None)
+    else:
+        out["tflops_per_s"] = None
+        out["mfu"] = None
     out["vs_baseline_grad_steps"] = round(flagship / CAFFE_STEPS_PER_S, 2)
 
     line = {
         "metric": "learner_grad_steps_per_sec",
         "value": round(flagship, 2),
         "unit": "steps/s",
-        "vs_baseline": round(flagship * BATCH / CAFFE_TRANSITIONS_PER_S, 2),
+        "vs_baseline": round(flagship * flag_batch
+                             / CAFFE_TRANSITIONS_PER_S, 2),
     }
     line.update(out)
     print(json.dumps(line))

@@ -85,20 +85,35 @@ def valid_mask(done: jax.Array, boundary: jax.Array, cursors: jax.Array,
     return (~(bad | cross)).reshape(-1)                 # [cap_local]
 
 
-def sample_from_cdf(key: jax.Array, prio_masked: jax.Array,
-                    num: int) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Inverse-CDF prioritized draw: ``num`` shard-local indices ∝ p.
-
-    Returns (indices [num], their probabilities p_i/mass [num], mass []).
-    One ``cumsum`` over the shard (memory-bound, HBM rate) replaces the
-    host sum-tree descent.
-    """
+def build_cdf(prio_masked: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(inclusive CDF, total mass) over a shard's masked priorities. ONE
+    ``cumsum`` over the shard (memory-bound, HBM rate) replaces the host
+    sum-tree descent. Capacity-scaled (O(cap_local) passes) — so the
+    chained path builds it ONCE per chunk: sampling is defined against
+    the priorities as of chunk start, making the CDF scan-invariant (the
+    in-scan version cost ~1.7 ms/step extra at 1M rows, measured)."""
     cdf = jnp.cumsum(prio_masked)
-    mass = cdf[-1]
+    return cdf, cdf[-1]
+
+
+def draw_from_cdf(key: jax.Array, cdf: jax.Array, prio_masked: jax.Array,
+                  mass: jax.Array, num: int,
+                  ) -> tuple[jax.Array, jax.Array]:
+    """``num`` inverse-CDF draws ∝ p: (indices [num], p_i/mass [num]).
+    [B]-scale only — safe inside a scan."""
     u = jax.random.uniform(key, (num,)) * mass
     idx = jnp.searchsorted(cdf, u, side="right")
     idx = jnp.clip(idx, 0, prio_masked.shape[0] - 1)
     p = prio_masked[idx] / jnp.maximum(mass, 1e-12)
+    return idx, p
+
+
+def sample_from_cdf(key: jax.Array, prio_masked: jax.Array,
+                    num: int) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Build + draw in one call (single-step convenience). Returns
+    (indices [num], probabilities p_i/mass [num], mass [])."""
+    cdf, mass = build_cdf(prio_masked)
+    idx, p = draw_from_cdf(key, cdf, prio_masked, mass, num)
     return idx, p, mass
 
 
@@ -136,21 +151,31 @@ def stack_rows_to_obs(rows: jax.Array,
     return jnp.moveaxis(rows, 1, -1)
 
 
-def compose_from_state(state_rows: dict[str, jax.Array], local: jax.Array,
-                       sub: jax.Array, slot_cap: int, stack: int,
-                       n_step: int, gamma: float) -> dict[str, jax.Array]:
-    """Device twin of ``FrameStackReplay.gather_meta`` + frame gather: from
-    sampled (sub, local) rows build obs/next_obs stack ROWS ([B, stack,
-    H·W] — see ``stack_rows_to_obs``), n-step return and bootstrap
-    discount — entirely from the shard's device rings."""
+def gather_rows(frames: jax.Array, flat_idx: jax.Array,
+                valid: jax.Array) -> jax.Array:
+    """``frames[flat_idx]`` with invalid stack positions zeroed — the ONE
+    place the pixel plane is touched. Kept OUT of any ``lax.scan``: a
+    gather inside a scan body makes XLA materialize a ring-sized temp per
+    iteration (measured: the compiled chained sample program carried a
+    471 MB temp ≈ one full 462 MB ring copy per step, ~2.5 ms/step at
+    batch 512 vs ~0.04 ms of actual gathered bytes). Batched over the
+    chunk, the leading dims of ``flat_idx`` are free."""
+    f = frames[flat_idx.reshape(-1)].reshape(flat_idx.shape + (-1,))
+    return f * valid[..., None].astype(jnp.uint8)
+
+
+def compose_meta(state_rows: dict[str, jax.Array], local: jax.Array,
+                 sub: jax.Array, slot_cap: int, stack: int,
+                 n_step: int, gamma: float):
+    """Device twin of ``FrameStackReplay.gather_meta``: from sampled
+    (sub, local) rows build the n-step return, bootstrap discount, action,
+    and the obs/next_obs WINDOW INDICES + validity masks (the pixel gather
+    itself happens outside, ``gather_rows``). Returns
+    (meta dict, oflat, ovalid, nflat, nvalid)."""
     L = slot_cap
-    frames, action = state_rows["frames"], state_rows["action"]
+    action = state_rows["action"]
     reward, done, boundary = (state_rows["reward"], state_rows["done"],
                               state_rows["boundary"])
-
-    def gather_frames(flat_idx, valid):
-        f = frames[flat_idx]                            # [B, S, H·W]
-        return f * valid[..., None].astype(jnp.uint8)
 
     oflat, ovalid = _stack_window(boundary, local, sub, L, stack)
     nflat, nvalid = _stack_window(boundary, (local + n_step) % L, sub, L,
@@ -167,39 +192,68 @@ def compose_from_state(state_rows: dict[str, jax.Array], local: jax.Array,
     any_done = (d & continuing).any(axis=1)
     discount = jnp.where(any_done, 0.0, gammas[n_step]).astype(jnp.float32)
     flat = sub * L + local
-    return {
-        "obs_rows": gather_frames(oflat, ovalid),
-        "nobs_rows": gather_frames(nflat, nvalid),
+    meta = {
         "action": action[flat],
         "reward": r.astype(jnp.float32),
         "discount": discount,
     }
+    return meta, oflat, ovalid, nflat, nvalid
 
 
-def fused_sample(key: jax.Array, shard_rows: dict[str, jax.Array],
-                 cursors: jax.Array, sizes: jax.Array, per_shard: int,
-                 slot_cap: int, stack: int, n_step: int, gamma: float,
-                 beta: jax.Array, num_shards: int,
-                 ) -> tuple[dict[str, jax.Array], jax.Array]:
-    """One shard's fused prioritized sample: mask → CDF draw → compose →
-    IS weights. Returns (batch dict incl. ``weight``, with obs as flat
-    ``*_rows`` stacks — see ``stack_rows_to_obs``; sampled shard-local
-    indices). Runs inside the learner's shard_map; ``lax.p*`` collectives
-    finish the cross-shard reductions."""
+def compose_from_state(state_rows: dict[str, jax.Array], local: jax.Array,
+                       sub: jax.Array, slot_cap: int, stack: int,
+                       n_step: int, gamma: float) -> dict[str, jax.Array]:
+    """Meta composition + the pixel gather in one call — the single-step
+    (unchained) convenience wrapper over ``compose_meta``/``gather_rows``.
+    """
+    meta, oflat, ovalid, nflat, nvalid = compose_meta(
+        state_rows, local, sub, slot_cap, stack, n_step, gamma)
+    return {
+        **meta,
+        "obs_rows": gather_rows(state_rows["frames"], oflat, ovalid),
+        "nobs_rows": gather_rows(state_rows["frames"], nflat, nvalid),
+    }
+
+
+def fused_sample_prep(shard_rows: dict[str, jax.Array],
+                      cursors: jax.Array, sizes: jax.Array,
+                      slot_cap: int, stack: int, n_step: int):
+    """The CAPACITY-SCALED part of a fused prioritized sample, built once
+    per chunk (scan-invariant: the chained path samples against the
+    priorities as of chunk start): validity mask → masked priorities →
+    CDF/mass → global sampleable count. Returns (pm, cdf, mass, n_glob).
+    """
     from jax import lax
 
     mask = valid_mask(shard_rows["done"], shard_rows["boundary"], cursors,
                       sizes, slot_cap, stack, n_step)
     pm = shard_rows["prio"] * mask
-    idx, p, mass = sample_from_cdf(key, pm, per_shard)
+    cdf, mass = build_cdf(pm)
+    n_glob = lax.psum(jnp.sum(mask.astype(jnp.float32)), "dp")
+    return pm, cdf, mass, n_glob
+
+
+def fused_sample_draw(key: jax.Array, shard_rows: dict[str, jax.Array],
+                      pm: jax.Array, cdf: jax.Array, mass: jax.Array,
+                      n_glob: jax.Array, per_shard: int, slot_cap: int,
+                      stack: int, n_step: int, gamma: float,
+                      beta: jax.Array, num_shards: int):
+    """The [B]-scale part of a fused prioritized sample — safe to
+    ``lax.scan``: CDF draw → meta composition → IS weights. Returns
+    (meta batch incl. ``weight``, oflat, ovalid, nflat, nvalid, idx);
+    the pixel gather happens outside, once per chunk (``gather_rows``).
+    Runs inside the learner's shard_map; ``lax.pmax`` finishes the
+    cross-shard weight normalization."""
+    from jax import lax
+
+    idx, p = draw_from_cdf(key, cdf, pm, mass, per_shard)
     sub, local = idx // slot_cap, idx % slot_cap
-    batch = compose_from_state(shard_rows, local, sub, slot_cap, stack,
-                               n_step, gamma)
+    batch, oflat, ovalid, nflat, nvalid = compose_meta(
+        shard_rows, local, sub, slot_cap, stack, n_step, gamma)
     # IS weights for the realized stratified draw: P(i) = p_i/(D·mass_s)
     # (each shard contributes exactly per_shard draws — matches the host
     # path's DeviceFrameReplay.sample weight math), N = global sampleable
-    # transition count.
-    n_glob = lax.psum(jnp.sum(mask.astype(jnp.float32)), "dp")
+    # transition count (``n_glob``, psum'd once per chunk in prep).
     pr = jnp.maximum(p / num_shards, 1e-12)
     w = (n_glob * pr) ** (-beta)
     # a shard whose masked priority mass is zero (e.g. its only sampleable
@@ -214,7 +268,39 @@ def fused_sample(key: jax.Array, shard_rows: dict[str, jax.Array],
     w_max = lax.pmax(jnp.max(w), "dp")
     batch["weight"] = (w / jnp.maximum(w_max, 1e-12)).astype(jnp.float32)
     idx = jnp.where(mass > 0, idx, pm.shape[0])
-    return batch, idx.astype(jnp.int32)
+    return batch, oflat, ovalid, nflat, nvalid, idx.astype(jnp.int32)
+
+
+def fused_sample_indices(key: jax.Array, shard_rows: dict[str, jax.Array],
+                         cursors: jax.Array, sizes: jax.Array,
+                         per_shard: int, slot_cap: int, stack: int,
+                         n_step: int, gamma: float, beta: jax.Array,
+                         num_shards: int):
+    """prep + draw in one call (single-step / test convenience)."""
+    pm, cdf, mass, n_glob = fused_sample_prep(
+        shard_rows, cursors, sizes, slot_cap, stack, n_step)
+    return fused_sample_draw(key, shard_rows, pm, cdf, mass, n_glob,
+                             per_shard, slot_cap, stack, n_step, gamma,
+                             beta, num_shards)
+
+
+def fused_sample(key: jax.Array, shard_rows: dict[str, jax.Array],
+                 cursors: jax.Array, sizes: jax.Array, per_shard: int,
+                 slot_cap: int, stack: int, n_step: int, gamma: float,
+                 beta: jax.Array, num_shards: int,
+                 ) -> tuple[dict[str, jax.Array], jax.Array]:
+    """Single-step convenience: indices + the pixel gather in one call.
+    Returns (batch dict incl. ``weight``, with obs as flat ``*_rows``
+    stacks — see ``stack_rows_to_obs``; sampled shard-local indices).
+    The chained learner path hoists ``fused_sample_prep`` and the gather
+    out of its scan instead."""
+    batch, oflat, ovalid, nflat, nvalid, idx = fused_sample_indices(
+        key, shard_rows, cursors, sizes, per_shard, slot_cap, stack,
+        n_step, gamma, beta, num_shards)
+    batch = dict(batch)
+    batch["obs_rows"] = gather_rows(shard_rows["frames"], oflat, ovalid)
+    batch["nobs_rows"] = gather_rows(shard_rows["frames"], nflat, nvalid)
+    return batch, idx
 
 
 def scatter_priorities(prio: jax.Array, maxp: jax.Array, idx: jax.Array,

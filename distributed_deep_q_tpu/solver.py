@@ -12,6 +12,7 @@ parameter-server round trip.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any
 
 import jax
@@ -221,8 +222,6 @@ class FusedStepStream:
 
     def __init__(self, solver: Solver, replay, chain: int,
                  dispatch_lock=None, timer=None):
-        import contextlib
-
         self._solver = solver
         self._replay = replay
         self.chain = max(int(chain), 1)
@@ -240,8 +239,6 @@ class FusedStepStream:
         ``fused_chain`` to avoid it.
         """
         if self._pending == 0:
-            import contextlib
-
             self._len = min(self.chain, max(int(steps_left), 1))
             phase = (self._timer.phase("dispatch") if self._timer
                      else contextlib.nullcontext())

@@ -51,15 +51,42 @@ def lookup(table: dict, kind: str):
     return None
 
 
-def time_program(fn, args, iters: int, donate_state: bool = False):
-    """Median seconds/call of a compiled program. ``donate_state`` reuses
-    the returned state as the next call's first arg (train-step style)."""
+def _fence(out):
+    """TRUE device sync: D2H-read the smallest output leaf (data-depends
+    on the whole call chain). ``block_until_ready`` is NOT a fence on the
+    tunneled runtime — it acks enqueue (50 chained 8192³ bf16 matmuls
+    "ready" in 1.6 ms ≈ 34 PF/s, impossible); see bench.py docstring."""
     import jax
 
+    leaf = min(jax.tree_util.tree_leaves(out), key=lambda x: x.size)
+    return np.asarray(jax.device_get(leaf))
+
+
+def time_program(fn, args, iters: int, donate_state: bool = False):
+    """Median seconds/call of a compiled program, fenced by D2H readback;
+    the separately measured fence RTT is subtracted from each rep.
+    ``donate_state`` reuses the returned state as the next call's first
+    arg (train-step style)."""
+    import jax
+    import jax.numpy as jnp
+
     out = fn(*args)
-    jax.block_until_ready(out)
+    _fence(out)
     if donate_state:
         args = (out[0],) + args[1:]
+    # RTT = median first read of FRESH drained buffers (a re-read of a
+    # fetched array hits jax's host-side cache and measures ~0.1 ms, not
+    # the tunnel round trip; median of 3 — one jittery round trip must
+    # not skew every rep's subtraction)
+    leaf = min(jax.tree_util.tree_leaves(out), key=lambda x: x.size)
+    rtts = []
+    for k in range(3):
+        fresh = jnp.asarray(leaf) + k
+        time.sleep(0.25)
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(fresh))
+        rtts.append(time.perf_counter() - t0)
+    rtt = float(np.median(rtts))
     rates = []
     for _ in range(REPS):
         a = args
@@ -68,8 +95,8 @@ def time_program(fn, args, iters: int, donate_state: bool = False):
             out = fn(*a)
             if donate_state:
                 a = (out[0],) + a[1:]
-        jax.block_until_ready(out)
-        rates.append((time.perf_counter() - t0) / iters)
+        _fence(out)
+        rates.append(max(time.perf_counter() - t0 - rtt, 1e-9) / iters)
         if donate_state:
             args = (out[0],) + args[1:]
     return float(np.median(rates)), args
