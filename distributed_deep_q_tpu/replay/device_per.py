@@ -238,24 +238,70 @@ def fused_sample_draw(key: jax.Array, shard_rows: dict[str, jax.Array],
                       n_glob: jax.Array, per_shard: int, slot_cap: int,
                       stack: int, n_step: int, gamma: float,
                       beta: jax.Array, num_shards: int):
-    """The [B]-scale part of a fused prioritized sample — safe to
-    ``lax.scan``: CDF draw → meta composition → IS weights. Returns
-    (meta batch incl. ``weight``, oflat, ovalid, nflat, nvalid, idx);
-    the pixel gather happens outside, once per chunk (``gather_rows``).
-    Runs inside the learner's shard_map; ``lax.pmax`` finishes the
-    cross-shard weight normalization."""
+    """One step's [B]-scale fused prioritized sample: CDF draw → meta
+    composition → IS weights. Exactly ``fused_sample_draw_many`` with
+    chain=1 (single implementation — the two paths must never drift);
+    the pixel gather happens outside (``gather_rows``). Runs inside the
+    learner's shard_map; ``lax.pmax`` finishes the cross-shard weight
+    normalization."""
+    batch, oflat, ovalid, nflat, nvalid, idx = fused_sample_draw_many(
+        key[None], shard_rows, pm, cdf, mass, n_glob, per_shard, slot_cap,
+        stack, n_step, gamma, jnp.asarray(beta)[None], num_shards)
+    batch = {k: v[0] for k, v in batch.items()}
+    return (batch, oflat[0], ovalid[0], nflat[0], nvalid[0], idx[0])
+
+
+def fused_sample_draw_many(keys: jax.Array,
+                           shard_rows: dict[str, jax.Array],
+                           pm: jax.Array, cdf: jax.Array, mass: jax.Array,
+                           n_glob: jax.Array, per_shard: int, slot_cap: int,
+                           stack: int, n_step: int, gamma: float,
+                           betas: jax.Array, num_shards: int):
+    """``fused_sample_draw`` vectorized over the chain axis — ONE
+    straight-line program for all ``chain`` batches of a chunk instead of a
+    ``lax.scan`` of per-step bodies.
+
+    The scan bought nothing: the draw has no carry (sampling is defined
+    against chunk-start priorities, so every step's body is independent),
+    while costing real capacity-scaled work per iteration — the body
+    gathers from the [cap_local] metadata/priority rows, and XLA's scan
+    lowering re-touches those operands every iteration (the round-4 bench
+    measured the 1M-ring in-scan step at 3.1 ms vs 1.79 ms at 65k with
+    byte-identical [B]-scale math — the delta is capacity-sized scan
+    traffic, same family as the hoisted-gather pathology documented on
+    ``gather_rows``). Vectorized, each capacity-sized array is touched
+    once per chunk.
+
+    Per-step key semantics are preserved bit-for-bit: row i draws
+    ``uniform(keys[i], (per_shard,))`` — the vmap computes the same
+    Threefry bits as ``chain`` separate calls, so a chain=k chunk still
+    byte-matches k single-step dispatches (test_device_per.py
+    ``test_chained_fused_steps_match_sequential_alpha0``).
+
+    ``keys`` is [chain, 2] uint32, ``betas`` [chain]. Returns the same
+    tuple as ``fused_sample_draw`` with a leading [chain] axis everywhere.
+    """
     from jax import lax
 
-    idx, p = draw_from_cdf(key, cdf, pm, mass, per_shard)
+    chain = keys.shape[0]
+    idx, p = jax.vmap(
+        lambda k: draw_from_cdf(k, cdf, pm, mass, per_shard))(keys)
     sub, local = idx // slot_cap, idx % slot_cap
-    batch, oflat, ovalid, nflat, nvalid = compose_meta(
-        shard_rows, local, sub, slot_cap, stack, n_step, gamma)
+    meta, oflat, ovalid, nflat, nvalid = compose_meta(
+        shard_rows, local.reshape(-1), sub.reshape(-1), slot_cap, stack,
+        n_step, gamma)
+    lead = (chain, per_shard)
+    meta = {k: v.reshape(lead + v.shape[1:]) for k, v in meta.items()}
+    oflat, ovalid, nflat, nvalid = (
+        x.reshape(lead + x.shape[1:])
+        for x in (oflat, ovalid, nflat, nvalid))
     # IS weights for the realized stratified draw: P(i) = p_i/(D·mass_s)
     # (each shard contributes exactly per_shard draws — matches the host
     # path's DeviceFrameReplay.sample weight math), N = global sampleable
-    # transition count (``n_glob``, psum'd once per chunk in prep).
+    # transition count (``n_glob``, psum'd once per chunk in prep). Each
+    # chain row normalizes against ITS step's cross-shard max.
     pr = jnp.maximum(p / num_shards, 1e-12)
-    w = (n_glob * pr) ** (-beta)
+    w = (n_glob * pr) ** (-betas[:, None])
     # a shard whose masked priority mass is zero (e.g. its only sampleable
     # slot sealed away post-warmup) would otherwise compose garbage rows
     # with extreme weights: zero those weights and point the priority
@@ -265,10 +311,11 @@ def fused_sample_draw(key: jax.Array, shard_rows: dict[str, jax.Array],
     # w up to ~1e4, and normalizing live shards by THAT w_max would crush
     # the whole batch's learning signal.
     w = jnp.where(mass > 0, w, 0.0)
-    w_max = lax.pmax(jnp.max(w), "dp")
-    batch["weight"] = (w / jnp.maximum(w_max, 1e-12)).astype(jnp.float32)
+    w_max = lax.pmax(jnp.max(w, axis=1), "dp")             # [chain]
+    meta["weight"] = (w / jnp.maximum(w_max[:, None], 1e-12)
+                      ).astype(jnp.float32)
     idx = jnp.where(mass > 0, idx, pm.shape[0])
-    return batch, oflat, ovalid, nflat, nvalid, idx.astype(jnp.int32)
+    return meta, oflat, ovalid, nflat, nvalid, idx.astype(jnp.int32)
 
 
 def fused_sample_indices(key: jax.Array, shard_rows: dict[str, jax.Array],

@@ -1,0 +1,139 @@
+"""Ablate the fused sample program at 1M capacity to find its hot spot.
+
+Variants (same shard_map/jit structure as Learner._build_device_per_step's
+sample program, chain=32, batch=512):
+  full        — the real program
+  nosearch    — inverse-CDF searchsorted replaced by direct u*cap index
+  nocompose   — + meta composition dropped (windows from raw idx)
+  gather_only — the two frame-row gathers alone, fixed indices
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench import build, _fence_rtt  # noqa: E402
+from distributed_deep_q_tpu import config as cfg_mod  # noqa: E402
+from distributed_deep_q_tpu.parallel.mesh import AXIS_DP  # noqa: E402
+from distributed_deep_q_tpu.replay.device_per import (  # noqa: E402
+    _stack_window, compose_meta, fused_sample_prep, gather_rows)
+
+CHAIN, BATCH = 32, 512
+
+
+def note(m):
+    print(f"[a] {m}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    cap = 1_000_000
+    note("build")
+    solver, replay = build(cfg_mod, capacity=cap, batch=BATCH,
+                           prioritized=True, pallas=False, device_per=True,
+                           prefill=60_000)
+    mesh = solver.mesh
+    slot_cap, stack, n_step, gamma = (replay.slot_cap, replay.stack,
+                                      replay.n_step, replay.gamma)
+    per_shard = BATCH // replay.num_shards
+    num_shards = replay.num_shards
+    rows = replay.dstate
+    cursors, sizes = replay.device_inputs()
+    betas = np.full(CHAIN, 0.5, np.float32)
+    keys = solver._next_sample_keys(replay.num_shards, CHAIN)
+
+    S = P(AXIS_DP)
+    SK = P(None, AXIS_DP)
+
+    def make(variant):
+        def sample_fn(keys, frames, action, reward, done, boundary, prio,
+                      cursors, sizes, betas):
+            shard_rows = {"action": action, "reward": reward, "done": done,
+                          "boundary": boundary, "prio": prio}
+            pm, cdf, mass, n_glob = fused_sample_prep(
+                shard_rows, cursors, sizes, slot_cap, stack, n_step)
+            k = keys[0]
+            u = jax.vmap(lambda kk: jax.random.uniform(kk, (per_shard,)))(k)
+            if variant == "full":
+                idx = jnp.searchsorted(cdf, u * mass, side="right")
+            else:
+                idx = (u * pm.shape[0]).astype(jnp.int32)
+            idx = jnp.clip(idx, 0, pm.shape[0] - 1)
+            sub, local = idx // slot_cap, idx % slot_cap
+            fl, fs = local.reshape(-1), sub.reshape(-1)
+            if variant in ("full", "nosearch"):
+                meta, oflat, ovalid, nflat, nvalid = compose_meta(
+                    shard_rows, fl, fs, slot_cap, stack, n_step, gamma)
+            else:
+                oflat, ovalid = _stack_window(boundary, fl, fs, slot_cap,
+                                              stack)
+                nflat, nvalid = oflat, ovalid
+            lead = (CHAIN, per_shard)
+            oflat = oflat.reshape(lead + oflat.shape[1:])
+            ovalid = ovalid.reshape(lead + ovalid.shape[1:])
+            nflat = nflat.reshape(lead + nflat.shape[1:])
+            nvalid = nvalid.reshape(lead + nvalid.shape[1:])
+            obs = gather_rows(frames, oflat, ovalid)
+            nobs = gather_rows(frames, nflat, nvalid)
+            return obs, nobs, idx.astype(jnp.int32)
+
+        return jax.jit(shard_map(
+            sample_fn, mesh=mesh,
+            in_specs=(S, S, S, S, S, S, S, S, S, P()),
+            out_specs=(SK, SK, SK), check_vma=False))
+
+    def gather_only():
+        rng = np.random.default_rng(0)
+        anchors = rng.integers(0, cap, (CHAIN, BATCH)).astype(np.int32)
+        offs = np.arange(3, -1, -1, dtype=np.int32)
+        widx = jnp.asarray((anchors[..., None] - offs) % slot_cap
+                           + (anchors[..., None] // slot_cap) * slot_cap)
+        valid = jnp.ones(widx.shape, bool)
+
+        def fn(frames, widx, valid):
+            return (gather_rows(frames, widx, valid),
+                    gather_rows(frames, widx, valid),
+                    widx[..., 0])
+
+        return jax.jit(shard_map(
+            fn, mesh=mesh, in_specs=(S, SK, SK), out_specs=(SK, SK, SK),
+            check_vma=False)), widx, valid
+
+    rtt = None
+    for variant in ("full", "nosearch", "nocompose", "gather_only"):
+        note(variant)
+        if variant == "gather_only":
+            fn, widx, valid = gather_only()
+            args = (rows.frames, widx, valid)
+        else:
+            fn = make(variant)
+            args = (keys, rows.frames, rows.action, rows.reward, rows.done,
+                    rows.boundary, rows.prio, np.asarray(cursors),
+                    np.asarray(sizes), betas)
+
+        def call():
+            out = fn(*args)
+            int(jax.device_get(out[2][0, 0]))
+
+        call()
+        if rtt is None:
+            rtt = _fence_rtt(solver)
+        ts = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            call()
+            ts.append(time.perf_counter() - t0 - rtt)
+        print(f"{variant:>12}: {1e3 * float(np.median(ts)):8.2f} ms/chunk",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
