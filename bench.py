@@ -389,8 +389,7 @@ def run_writers(replay, lock: threading.Lock, stop: threading.Event,
             # backpressure: staged rows the learner hasn't flushed yet are
             # host RSS — bound them instead of growing without limit while
             # the learner compiles or drains a fenced rep
-            while (sum(replay._pending_rows) > 32_768
-                   and not stop.is_set()):
+            while replay.pending_rows() > 32_768 and not stop.is_set():
                 time.sleep(0.005)
             done = np.zeros(chunk, bool)
             done[-1] = (t % 10 == 9)  # an episode boundary every ~10 chunks

@@ -227,39 +227,39 @@ class Learner:
         host ships per-slot cursors/sizes, β, and sampling keys; NOTHING
         is read back (the per-sample |TD| never leaves the device).
 
-        ``chain`` > 1 amortizes dispatch: each program ``lax.scan``s its
-        body ``chain`` times per call, so the host pays flush/cursor/key
-        bookkeeping and TWO dispatches per ``chain`` grad steps instead of
-        per step. Semantics of the chained chunk: the SAMPLE program draws
-        all ``chain`` batches against the priorities as of chunk start
-        (within-chunk staleness ≤ chain steps — the same bound the host
-        path's ``DelayedPriorityWriteback(depth=8)`` already accepts),
-        while the TRAIN program applies the ``chain`` optimizer steps and
-        priority scatters strictly in order. Across chunks everything is
-        fresh."""
-        (slot_cap, stack, n_step, gamma, frame_shape, per_shard, alpha,
-         eps, num_shards) = spec
+        ``chain`` > 1 amortizes dispatch: the SAMPLE program draws all
+        ``chain`` batches against chunk-start priorities in ONE
+        straight-line vectorized block (no scan — per-step draws have no
+        carry, and scanned bodies re-touch capacity-sized arrays per
+        iteration), while the TRAIN program ``lax.scan``s the ``chain``
+        optimizer steps and priority scatters strictly in order.
+        Within-chunk priority staleness ≤ chain steps — the same bound
+        the host path's ``DelayedPriorityWriteback(depth=8)`` accepts.
+        Across chunks everything is fresh.
+
+        Data plane (round 5): the sample program composes metadata from
+        the per-row ``build_meta_pack`` (two row gathers per sample) and
+        copies each sample's combined obs+next-obs pixel window with the
+        Pallas row-DMA kernel (``ops/ring_gather.py`` — 3 ms vs 44 ms
+        for the tiled XLA gathers it replaced at the 1M-ring shape); the
+        train program slices obs/next-obs out of the windows, applies the
+        validity bit-planes, and runs the DQN step. Keys stay
+        host-generated (a fold_in-keyed program executed the ring gather
+        ~200× slower — measured minimal pair, r3)."""
+        (slot_cap, slot_pad, rowb, row_len, stack, n_step, gamma,
+         frame_shape, per_shard, alpha, eps, num_shards, interpret) = spec
+        from distributed_deep_q_tpu.ops.ring_gather import gather_windows
         from distributed_deep_q_tpu.replay.device_per import (
-            fused_sample_draw_many, fused_sample_prep, gather_rows,
+            build_meta_pack, fused_sample_draw_packed, fused_sample_prep,
             scatter_priorities, stack_rows_to_obs)
 
         S = P(AXIS_DP)
-        SK = P(None, AXIS_DP)  # [chain, B]-stacked outputs, batch-sharded
-
-        # TWO programs, not one, and NO key derivation on device. Two
-        # measured XLA:TPU pathologies shape this structure (each costs a
-        # full relayout copy of the frame ring per step — 29 ms at 1M):
-        # 1. a program where the gathered pixels flow into the CNN (or out
-        #    through a transpose) back-propagates the consumer layout onto
-        #    the ring operand;
-        # 2. a program whose sampling key comes from jax.random.fold_in
-        #    executes the ring gather ~200× slower than the same program
-        #    with the key as a plain argument (minimal pair measured:
-        #    0.05 ms vs 8.5 ms at 262k rows).
-        # So: the sample program takes per-shard keys as an argument
-        # (host-generated, ~bytes/step — the same plane that ships
-        # cursors), returns gather-natural flat stacks, and the train
-        # program does the reshape + CNN + priority scatter.
+        SK = P(None, AXIS_DP)   # [chain, B]-stacked outputs, batch-sharded
+        SK3 = P(None, AXIS_DP, None)
+        SWIN = P(None, AXIS_DP, None, None)
+        window = stack + n_step
+        n_win = chain * per_shard
+        rowp = rowb // 4        # int32 elements per padded frame row
 
         def sample_fn(keys, frames, action, reward, done, boundary, prio,
                       cursors, sizes, betas):
@@ -267,61 +267,56 @@ class Learner:
                 "action": action, "reward": reward,
                 "done": done, "boundary": boundary, "prio": prio,
             }
-            # NO scan anywhere in the sample program: the per-step draws
-            # have no carry (sampling is defined against chunk-start
-            # priorities), so all chain batches are drawn/composed in one
-            # straight-line vectorized block — every capacity-sized array
-            # (mask, CDF, metadata rows, the frame ring) is touched ONCE
-            # per chunk. The scanned version re-touched the [cap_local]
-            # metadata rows per iteration (round-4 measured the 1M-ring
-            # in-scan step at 3.1 ms vs 1.79 ms at 65k on identical
-            # [B]-scale math — capacity-sized scan traffic).
             pm, cdf, mass, n_glob = fused_sample_prep(
                 shard_rows, cursors, sizes, slot_cap, stack, n_step)
+            pack = build_meta_pack(action, reward, done, boundary,
+                                   slot_cap, stack, n_step, gamma)
             # keys arrives [1, chain, 2] per shard (sharded over dim 0)
-            metas, oflats, ovalids, nflats, nvalids, idxs = \
-                fused_sample_draw_many(
-                    keys[0], shard_rows, pm, cdf, mass, n_glob,
-                    per_shard, slot_cap, stack, n_step, gamma, betas,
-                    num_shards)
-            batches = dict(metas)
-            batches["obs_rows"] = gather_rows(frames, oflats, ovalids)
-            batches["nobs_rows"] = gather_rows(frames, nflats, nvalids)
-            return batches, idxs
+            metas, ws, idxs = fused_sample_draw_packed(
+                keys[0], pack, pm, cdf, mass, n_glob, per_shard,
+                slot_cap, slot_pad, stack, n_step, betas, num_shards)
+            win = gather_windows(ws.reshape(-1), frames, n=n_win,
+                                 w=window, rowb=rowb, interpret=interpret)
+            return metas, win.reshape(chain, per_shard, window, rowp), idxs
 
+        meta_spec = {"action": SK, "reward": SK, "discount": SK,
+                     "weight": SK, "ovalid": SK3, "nvalid": SK3}
         sample = jax.jit(shard_map(
             sample_fn, mesh=self.mesh,
             in_specs=(S, S, S, S, S, S, S, S, S, P()),
-            out_specs=({k: SK for k in ("obs_rows", "nobs_rows", "action",
-                                        "reward", "discount", "weight")},
-                       SK),
+            out_specs=(meta_spec, SWIN, SK),
             check_vma=False))
 
-        def train_fn(state: TrainState, batches, idxs, prio, maxp):
-            def body(carry, batch_idx):
+        def train_fn(state: TrainState, metas, win, idxs, prio, maxp):
+            def body(carry, xs):
                 state, prio, maxp = carry
-                batch, idx = batch_idx
+                batch, w, idx = xs
                 batch = dict(batch)
-                batch["obs"] = stack_rows_to_obs(batch.pop("obs_rows"),
-                                                 frame_shape)
-                batch["next_obs"] = stack_rows_to_obs(
-                    batch.pop("nobs_rows"), frame_shape)
+                ovalid = batch.pop("ovalid")
+                nvalid = batch.pop("nvalid")
+                # unpack int32 → pixel bytes (little-endian round trip
+                # with the host's uint8.view(int32), verified both
+                # platforms), drop the DMA row padding
+                pix = lax.bitcast_convert_type(w, jnp.uint8)
+                pix = pix.reshape(w.shape[:2] + (rowp * 4,))[:, :, :row_len]
+                obs = pix[:, :stack] * ovalid[..., None]
+                nobs = pix[:, n_step:n_step + stack] * nvalid[..., None]
+                batch["obs"] = stack_rows_to_obs(obs, frame_shape)
+                batch["next_obs"] = stack_rows_to_obs(nobs, frame_shape)
                 state, metrics, td_abs = self._step_core(state, batch)
                 prio, maxp = scatter_priorities(prio, maxp, idx, td_abs,
                                                 alpha, eps)
                 return (state, prio, maxp), metrics
 
             (state, prio, maxp), metrics = lax.scan(
-                body, (state, prio, maxp), (batches, idxs))
+                body, (state, prio, maxp), (metas, win, idxs))
             return state, prio, maxp, metrics
 
         train = jax.jit(shard_map(
             train_fn, mesh=self.mesh,
-            in_specs=(P(), {k: SK for k in ("obs_rows", "nobs_rows",
-                                            "action", "reward", "discount",
-                                            "weight")}, SK, S, P()),
+            in_specs=(P(), meta_spec, SWIN, SK, S, P()),
             out_specs=(P(), S, P(), P()),
-            check_vma=False), donate_argnums=(0, 3, 4))
+            check_vma=False), donate_argnums=(0, 4, 5))
         return sample, train
 
     def train_steps_device_per(self, state: TrainState, rows, cursors,
@@ -339,12 +334,12 @@ class Learner:
             self._device_per_steps[cache_key] = \
                 self._build_device_per_step(spec, chain)
         sample, train = self._device_per_steps[cache_key]
-        batch, idx = sample(keys, rows.frames, rows.action,
-                            rows.reward, rows.done, rows.boundary,
-                            rows.prio, np.asarray(cursors),
-                            np.asarray(sizes),
-                            np.asarray(betas, np.float32))
-        return train(state, batch, idx, rows.prio, rows.maxp)
+        metas, win, idx = sample(keys, rows.frames, rows.action,
+                                 rows.reward, rows.done, rows.boundary,
+                                 rows.prio, np.asarray(cursors),
+                                 np.asarray(sizes),
+                                 np.asarray(betas, np.float32))
+        return train(state, metas, win, idx, rows.prio, rows.maxp)
 
     def train_step(self, state: TrainState, batch: dict[str, Any]):
         """One synchronous DP gradient step.

@@ -239,11 +239,13 @@ def fused_sample_draw(key: jax.Array, shard_rows: dict[str, jax.Array],
                       stack: int, n_step: int, gamma: float,
                       beta: jax.Array, num_shards: int):
     """One step's [B]-scale fused prioritized sample: CDF draw → meta
-    composition → IS weights. Exactly ``fused_sample_draw_many`` with
-    chain=1 (single implementation — the two paths must never drift);
-    the pixel gather happens outside (``gather_rows``). Runs inside the
-    learner's shard_map; ``lax.pmax`` finishes the cross-shard weight
-    normalization."""
+    composition → IS weights; ``fused_sample_draw_many`` at chain=1.
+
+    REFERENCE implementation, not the production path: the learner runs
+    ``fused_sample_draw_packed`` (pack row-gathers + window DMA); this
+    gather-based twin is the executable spec the packed path is tested
+    against (tests/test_device_per.py equivalence test) and what the
+    zero-mass/uniformity unit tests drive directly."""
     batch, oflat, ovalid, nflat, nvalid, idx = fused_sample_draw_many(
         key[None], shard_rows, pm, cdf, mass, n_glob, per_shard, slot_cap,
         stack, n_step, gamma, jnp.asarray(beta)[None], num_shards)
@@ -257,26 +259,21 @@ def fused_sample_draw_many(keys: jax.Array,
                            n_glob: jax.Array, per_shard: int, slot_cap: int,
                            stack: int, n_step: int, gamma: float,
                            betas: jax.Array, num_shards: int):
-    """``fused_sample_draw`` vectorized over the chain axis — ONE
-    straight-line program for all ``chain`` batches of a chunk instead of a
-    ``lax.scan`` of per-step bodies.
+    """All ``chain`` draws of a chunk in one straight-line vectorized
+    block (no scan: the draw has no carry — sampling is defined against
+    chunk-start priorities — and scanned bodies re-touch capacity-sized
+    operands per iteration).
 
-    The scan bought nothing: the draw has no carry (sampling is defined
-    against chunk-start priorities, so every step's body is independent),
-    while costing real capacity-scaled work per iteration — the body
-    gathers from the [cap_local] metadata/priority rows, and XLA's scan
-    lowering re-touches those operands every iteration (the round-4 bench
-    measured the 1M-ring in-scan step at 3.1 ms vs 1.79 ms at 65k with
-    byte-identical [B]-scale math — the delta is capacity-sized scan
-    traffic, same family as the hoisted-gather pathology documented on
-    ``gather_rows``). Vectorized, each capacity-sized array is touched
-    once per chunk.
+    REFERENCE twin of the production ``fused_sample_draw_packed``: this
+    composes meta through ``compose_meta``'s window gathers (clear,
+    tile-amplified); the packed path composes the same values from
+    ``build_meta_pack`` row lanes. The equivalence test in
+    tests/test_device_per.py holds the two together.
 
-    Per-step key semantics are preserved bit-for-bit: row i draws
-    ``uniform(keys[i], (per_shard,))`` — the vmap computes the same
-    Threefry bits as ``chain`` separate calls, so a chain=k chunk still
-    byte-matches k single-step dispatches (test_device_per.py
-    ``test_chained_fused_steps_match_sequential_alpha0``).
+    Per-step key semantics: row i draws ``uniform(keys[i], (per_shard,))``
+    — the vmap computes the same Threefry bits as ``chain`` separate
+    calls, so a chain=k chunk byte-matches k single-step dispatches
+    (``test_chained_fused_steps_match_sequential_alpha0``).
 
     ``keys`` is [chain, 2] uint32, ``betas`` [chain]. Returns the same
     tuple as ``fused_sample_draw`` with a leading [chain] axis everywhere.
@@ -316,6 +313,100 @@ def fused_sample_draw_many(keys: jax.Array,
                       ).astype(jnp.float32)
     idx = jnp.where(mass > 0, idx, pm.shape[0])
     return meta, oflat, ovalid, nflat, nvalid, idx.astype(jnp.int32)
+
+
+def build_meta_pack(action: jax.Array, reward: jax.Array, done: jax.Array,
+                    boundary: jax.Array, slot_cap: int, stack: int,
+                    n_step: int, gamma: float) -> jax.Array:
+    """Per-row composed sample metadata for ALL rows at once — the roll
+    twin of ``compose_meta``. Returns ``[cap_local, 3 + stack]`` float32:
+    lane 0 action, 1 n-step return, 2 bootstrap discount, 3.. the obs
+    stack-validity bits of the row as anchor (oldest-first).
+
+    Why: per-sample element gathers from the [cap_local] metadata rows
+    read a full (8,128)/(32,128) tile per element on TPU — measured
+    ~42 ms per 32-step chunk at 1M capacity (scripts/sample_ablate.py).
+    Rolls compose the same windows for every row in a handful of
+    sequential passes at HBM bandwidth, and the sampler then needs just
+    TWO row gathers per sample (anchor and anchor+n) from this pack.
+    ``jnp.roll`` wraps within each sub-ring after the ``[subs, L]``
+    reshape — exactly the mod-``L`` window math of ``compose_meta``.
+    """
+    L = slot_cap
+    a2 = action.reshape(-1, L).astype(jnp.float32)
+    r2 = reward.reshape(-1, L).astype(jnp.float32)
+    d2 = done.reshape(-1, L).astype(bool)
+    b2 = boundary.reshape(-1, L).astype(bool)
+    # n-step return / discount: row i's window rows are roll(-k)[i]
+    rn = r2
+    any_done = d2
+    cont = ~d2
+    for k in range(1, n_step):
+        dk = jnp.roll(d2, -k, axis=1)
+        rn = rn + jnp.roll(r2, -k, axis=1) * cont * (gamma ** k)
+        any_done = any_done | (dk & cont)
+        cont = cont & ~dk
+    disc = jnp.where(any_done, 0.0, gamma ** n_step).astype(jnp.float32)
+    # obs stack-validity bits (right-to-left like _stack_window): the
+    # anchor frame is always valid; older frames stay valid while no
+    # boundary sits between them and the anchor
+    vs: list = [None] * stack
+    vs[stack - 1] = jnp.ones_like(d2)
+    for j in range(stack - 2, -1, -1):
+        pb = jnp.roll(b2, stack - 1 - j, axis=1)
+        vs[j] = vs[j + 1] & ~pb
+    lanes = [a2, rn, disc] + [v.astype(jnp.float32) for v in vs]
+    return jnp.stack(lanes, axis=-1).reshape(-1, 3 + stack)
+
+
+def fused_sample_draw_packed(keys: jax.Array, pack: jax.Array,
+                             pm: jax.Array, cdf: jax.Array, mass: jax.Array,
+                             n_glob: jax.Array, per_shard: int,
+                             slot_cap: int, slot_pad: int, stack: int,
+                             n_step: int, betas: jax.Array,
+                             num_shards: int):
+    """The production draw for the padded-ring path: inverse-CDF draws for
+    all ``chain`` steps, metadata from TWO row gathers per sample off the
+    ``build_meta_pack`` pack, and the frame-window START rows for the
+    Pallas DMA gather (``ops/ring_gather.py``).
+
+    Returns (meta dict [chain, B] incl. ``weight`` and the obs/next-obs
+    validity bit-planes ``ovalid``/``nvalid`` [chain, B, stack] u8;
+    window-start rows ``ws`` [chain, B] in PADDED shard coords; sampled
+    row indices [chain, B] in real coords, OOB-masked for dead shards).
+    """
+    from jax import lax
+
+    chain = keys.shape[0]
+    idx, p = jax.vmap(
+        lambda k: draw_from_cdf(k, cdf, pm, mass, per_shard))(keys)
+    sub, local = idx // slot_cap, idx % slot_cap
+    anchor2 = sub * slot_cap + (local + n_step) % slot_cap
+    lanes = pack.shape[-1]
+    mp = pack[idx.reshape(-1)].reshape(chain, per_shard, lanes)
+    mp2 = pack[anchor2.reshape(-1)].reshape(chain, per_shard, lanes)
+    meta = {
+        "action": mp[..., 0].astype(jnp.int32),
+        "reward": mp[..., 1],
+        "discount": mp[..., 2],
+        "ovalid": mp[..., 3:3 + stack].astype(jnp.uint8),
+        "nvalid": mp2[..., 3:3 + stack].astype(jnp.uint8),
+    }
+    # IS weights — same math and dead-shard handling as
+    # fused_sample_draw_many (see the comments there; masking must
+    # precede the pmax)
+    pr = jnp.maximum(p / num_shards, 1e-12)
+    w = (n_glob * pr) ** (-betas[:, None])
+    w = jnp.where(mass > 0, w, 0.0)
+    w_max = lax.pmax(jnp.max(w, axis=1), "dp")
+    meta["weight"] = (w / jnp.maximum(w_max[:, None], 1e-12)
+                      ).astype(jnp.float32)
+    # window start (padded coords): rows [local-stack+1 .. local+n_step]
+    # are contiguous there thanks to the ghost rows — always in bounds
+    # (slot_pad = slot_cap + window - 1)
+    ws = sub * slot_pad + (local - (stack - 1)) % slot_cap
+    idx = jnp.where(mass > 0, idx, pm.shape[0])
+    return meta, ws.astype(jnp.int32), idx.astype(jnp.int32)
 
 
 def fused_sample_indices(key: jax.Array, shard_rows: dict[str, jax.Array],
@@ -375,10 +466,31 @@ class DevicePERFrameReplay(DeviceFrameReplay):
     (``Learner.train_step_device_per``), so per step the host ships only
     per-slot cursors/sizes (~a few hundred bytes) and reads back nothing.
 
+    Frame-plane layout (round 5 — built for the Pallas row-DMA kernels in
+    ``ops/ring_gather.py``; see that module's docstring for the measured
+    XLA gather pathology this replaces):
+
+    - frames live in ONE flat int32 array per mesh (pixel bytes packed
+      4-per-element — Mosaic's 32-bit index arithmetic caps u8-element
+      offsets below the flagship's 8 GB plane), sharded ``P('dp')``; each
+      frame row is padded to ``rowb`` bytes (a multiple of the 4 KB 1-D
+      tile) so any row range is DMA-alignable.
+    - each sub-ring holds ``slot_pad = slot_cap + window - 1`` rows where
+      ``window = stack + n_step``: the last ``window - 1`` rows are GHOST
+      rows mirroring rows ``0..window-2`` (the flush writes wrap rows
+      twice), so every sample's combined obs+next-obs window is ONE
+      contiguous ``window``-row DMA — no wrap handling on device.
+    - one extra SCRATCH row per shard at the end absorbs the flush's
+      padding lanes (the DMA scatter has no out-of-bounds drop).
+
+    Metadata/priority rows stay in REAL (unpadded) coordinates
+    ``[capacity]`` — only the pixel plane is padded/ghosted.
+
     Subclasses ``DeviceFrameReplay`` for all host-side slot bookkeeping
     (stream→slot routing, seal-on-restart, ready gating, the generic
-    chunked flush); the overrides widen the staging pipeline with
-    metadata columns and route writes to the full-state scatter.
+    chunked flush); the overrides pad staged frame rows, widen the
+    staging pipeline with metadata columns, and route writes to the
+    fused meta-scatter + frame-DMA program.
     """
 
     def __init__(self, cfg, mesh, frame_shape=(84, 84), stack: int = 4,
@@ -389,8 +501,10 @@ class DevicePERFrameReplay(DeviceFrameReplay):
         from jax import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from distributed_deep_q_tpu.ops.ring_gather import scatter_rows
         from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
 
+        self.__cfg_full = cfg  # _alloc_ring (called by super) needs n_step
         # host trees off: priorities live on device
         super().__init__(dataclasses.replace(cfg, prioritized=False), mesh,
                          frame_shape, stack, gamma, seed, write_chunk,
@@ -398,6 +512,8 @@ class DevicePERFrameReplay(DeviceFrameReplay):
         self.prioritized = True
         self._cfg = cfg  # base stored the trees-off copy; β fields match
         self.n_step, self.gamma = cfg.n_step, gamma
+        # frame column staged PADDED to the DMA row stride
+        self._stage_columns[0] = ((self.rowb,), np.uint8)
         self._stage_columns += [
             ((), np.int32), ((), np.float32), ((), np.uint8), ((), np.uint8)]
         self._di_cache: tuple[np.ndarray, np.ndarray] | None = None
@@ -408,7 +524,7 @@ class DevicePERFrameReplay(DeviceFrameReplay):
 
         # metadata/priority rings allocated directly on the mesh; the frame
         # ring is ADOPTED from the base allocation (NOT closed over in a
-        # jit — a captured 7 GB device array would be lowered as a constant)
+        # jit — a captured multi-GB device array would be lowered constant)
         def init_meta():
             return (jnp.zeros(cap, jnp.int32), jnp.zeros(cap, jnp.float32),
                     jnp.zeros(cap, jnp.uint8), jnp.zeros(cap, jnp.uint8),
@@ -436,16 +552,20 @@ class DevicePERFrameReplay(DeviceFrameReplay):
             donate_argnums=0)
 
         alpha = float(cfg.priority_alpha)
+        k = self.write_chunk
+        rowb, interpret = self.rowb, self._interpret
 
-        def write(rows, idx, frames, action, reward, done, boundary):
+        def write(rows, midx, act, rew, dn, bnd, sidx, didx, staged):
             new_p = rows.maxp ** alpha
+            frames = scatter_rows(sidx, didx, staged, rows.frames,
+                                  n=2 * k, rowb=rowb, interpret=interpret)
             return DeviceReplayState(
-                frames=rows.frames.at[idx].set(frames, mode="drop"),
-                action=rows.action.at[idx].set(action, mode="drop"),
-                reward=rows.reward.at[idx].set(reward, mode="drop"),
-                done=rows.done.at[idx].set(done, mode="drop"),
-                boundary=rows.boundary.at[idx].set(boundary, mode="drop"),
-                prio=rows.prio.at[idx].set(new_p, mode="drop"),
+                frames=frames,
+                action=rows.action.at[midx].set(act, mode="drop"),
+                reward=rows.reward.at[midx].set(rew, mode="drop"),
+                done=rows.done.at[midx].set(dn, mode="drop"),
+                boundary=rows.boundary.at[midx].set(bnd, mode="drop"),
+                prio=rows.prio.at[midx].set(new_p, mode="drop"),
                 maxp=rows.maxp,
             )
 
@@ -456,41 +576,93 @@ class DevicePERFrameReplay(DeviceFrameReplay):
             maxp=P_())
         # entry/exit layouts pinned to the live arrays' formats: XLA's
         # auto layout assignment may otherwise pick a transposed entry
-        # layout for the frame ring and relayout-copy the whole thing
-        # every flush (see Learner.train_step_device_per)
+        # layout for a metadata plane and relayout-copy it every flush
         state_fmt = jax.tree.map(lambda x: x.format, self.dstate)
         self._write_full = jax.jit(
             shard_map(write, mesh=mesh,
-                      in_specs=(state_spec, P_(AXIS_DP), P_(AXIS_DP),
-                                P_(AXIS_DP), P_(AXIS_DP), P_(AXIS_DP),
-                                P_(AXIS_DP)),
+                      in_specs=(state_spec,) + (P_(AXIS_DP),) * 8,
                       out_specs=state_spec,
                       check_vma=False),
-            in_shardings=(state_fmt, None, None, None, None, None, None),
+            in_shardings=(state_fmt,) + (None,) * 8,
             out_shardings=state_fmt,
             donate_argnums=0)
+
+    # -- padded frame plane --------------------------------------------------
+
+    def _alloc_ring(self) -> None:
+        """Flat padded u8 ring (see class docstring) instead of the base's
+        ``[capacity, H·W]`` scatter ring. Runs inside ``super().__init__``;
+        geometry derives from attributes the base set before the call."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributed_deep_q_tpu.ops.ring_gather import padded_row_bytes
+        from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
+
+        cfg = self.__cfg_full
+        self.window = self.stack + int(cfg.n_step)
+        assert self.slot_cap >= self.window, (
+            f"slot capacity {self.slot_cap} must hold one sample window "
+            f"(stack {self.stack} + n_step {cfg.n_step})")
+        self.slot_pad = self.slot_cap + self.window - 1
+        self.rowb = padded_row_bytes(self._row_len)   # bytes per frame row
+        self.rowp = self.rowb // 4                    # int32 per frame row
+        self.cap_local_pad = self.subs_per_shard * self.slot_pad
+        self.shard_rows = self.cap_local_pad + 1  # +1 scratch row
+        # Mosaic scalar index arithmetic is 32-bit: per-shard ELEMENT
+        # offsets must stay below 2^31 (ops/ring_gather.py docstring) —
+        # 1M frames of 84x84 sit at 2.048e9, inside by 4.6%
+        assert self.shard_rows * self.rowp < 2**31, (
+            f"per-shard frame plane ({self.shard_rows} rows x {self.rowp} "
+            "int32) exceeds Mosaic's 32-bit index range — shard over more "
+            "devices/processes or shrink capacity")
+        self._interpret = self.mesh.devices.flat[0].platform == "cpu"
+        shape = (self.num_shards * self.shard_rows * self.rowp,)
+        self.ring = jax.jit(
+            lambda: jnp.zeros(shape, jnp.int32),
+            out_shardings=NamedSharding(self.mesh, P(AXIS_DP)))()
+        self._write = None  # frames flush through _write_full's DMA plane
 
     # -- overridden write plumbing ------------------------------------------
 
     def _stage(self, slot: int, local, frames_arr) -> None:
-        """Stage (rows, frames, action, reward, done, boundary) — the
-        metadata comes from the host slot arrays the rows were just
+        """Stage (rows, PADDED frames, action, reward, done, boundary) —
+        the metadata comes from the host slot arrays the rows were just
         written to, gathered vectorized (fancy indexing copies)."""
         m = self.slots[slot]
         shard, base_off = self._slot_base(slot)
+        k = len(local)
+        padded = np.zeros((k, self.rowb), np.uint8)
+        padded[:, :self._row_len] = frames_arr
         self._pending[shard].append((
-            (base_off + local).astype(np.int32), frames_arr,
+            (base_off + local).astype(np.int32), padded,
             m.action[local], m.reward[local],
             m.done[local].astype(np.uint8),
             m.boundary[local].astype(np.uint8)))
-        self._pending_rows[shard] += len(local)
+        self._pending_rows[shard] += k
         self._di_cache = None  # cursors/sizes moved
 
     def _apply_write(self, idx, cols) -> None:
-        """Route each padded chunk to the full-state scatter, which also
-        seeds the fresh rows' priorities from the device max-priority
-        scalar."""
-        self.dstate = self._write_full(self.dstate, idx, *cols)
+        """Route each padded chunk to the fused write: metadata scatters
+        (real coords, fresh-row priorities seeded from the device max) +
+        the frame-row DMA plane (padded coords, ghost duplicates, padding
+        lanes → the scratch row)."""
+        d, k = self.num_shards, self.write_chunk
+        i2 = idx.reshape(d, k)
+        ok = i2 < self.cap_local
+        sub = np.where(ok, i2 // self.slot_cap, 0)
+        local = np.where(ok, i2 % self.slot_cap, 0)
+        scratch = self.cap_local_pad
+        main = np.where(ok, sub * self.slot_pad + local, scratch)
+        ghost = np.where(ok & (local < self.window - 1),
+                         sub * self.slot_pad + self.slot_cap + local,
+                         scratch)
+        src = np.tile(np.arange(k, dtype=np.int32), (d, 1))
+        sidx = np.concatenate([src, src], axis=1).reshape(-1)
+        didx = np.concatenate([main, ghost], axis=1).astype(
+            np.int32).reshape(-1)
+        staged = cols[0].reshape(-1).view(np.int32)  # packed pixel bytes
+        self.dstate = self._write_full(
+            self.dstate, idx, *cols[1:], sidx, didx, staged)
 
     def sample(self, batch_size: int):
         raise TypeError(

@@ -135,26 +135,8 @@ class DeviceFrameReplay:
                             for i in range(self.num_streams)]
         self._stream_pos = [0] * self.num_streams
 
-        # HBM ring, allocated directly with its dp sharding (no host copy).
-        # Frames are flattened to [H·W] rows — see compose_stacks for why
-        # (TPU (32,128) tiling of the minor dims).
-        ring_sharding = NamedSharding(mesh, P(AXIS_DP))
         self._row_len = int(np.prod(self.frame_shape))
-        shape = (self.capacity, self._row_len)
-        self.ring = jax.jit(
-            lambda: jnp.zeros(shape, jnp.uint8),
-            out_shardings=ring_sharding)()
-
-        # Donated scatter-writer: each device writes its chunk into its own
-        # ring shard; padding lanes carry idx == cap_local and are dropped.
-        def write(ring_local, idx, frames):
-            return ring_local.at[idx].set(frames, mode="drop")
-
-        self._write = jax.jit(
-            shard_map(write, mesh=mesh,
-                      in_specs=(P(AXIS_DP), P(AXIS_DP), P(AXIS_DP)),
-                      out_specs=P(AXIS_DP)),
-            donate_argnums=0)
+        self._alloc_ring()
 
         # host staging: per-shard FIFO of (in-shard offsets [n], *columns)
         # array chunks — array-granular so actor-rate ingest costs
@@ -165,6 +147,30 @@ class DeviceFrameReplay:
             ((self._row_len,), np.uint8)]
         self._pending: list[list[tuple]] = [[] for _ in range(d)]
         self._pending_rows = [0] * d
+
+    def _alloc_ring(self) -> None:
+        """Allocate the HBM frame plane + its scatter-writer. Overridden by
+        ``DevicePERFrameReplay`` (flat padded ring + Pallas row-DMA).
+
+        Frames are flattened to [H·W] rows — see compose_stacks for why
+        (TPU (32,128) tiling of the minor dims). Allocated directly with
+        the dp sharding (no host copy); the donated scatter lets each
+        device write its chunk into its own ring shard, padding lanes
+        carry idx == cap_local and are dropped."""
+        ring_sharding = NamedSharding(self.mesh, P(AXIS_DP))
+        shape = (self.capacity, self._row_len)
+        self.ring = jax.jit(
+            lambda: jnp.zeros(shape, jnp.uint8),
+            out_shardings=ring_sharding)()
+
+        def write(ring_local, idx, frames):
+            return ring_local.at[idx].set(frames, mode="drop")
+
+        self._write = jax.jit(
+            shard_map(write, mesh=self.mesh,
+                      in_specs=(P(AXIS_DP), P(AXIS_DP), P(AXIS_DP)),
+                      out_specs=P(AXIS_DP)),
+            donate_argnums=0)
 
     # -- layout helpers -----------------------------------------------------
 
@@ -186,6 +192,12 @@ class DeviceFrameReplay:
 
     def __len__(self) -> int:
         return sum(len(m) for m in self.slots)
+
+    def pending_rows(self) -> int:
+        """Rows staged but not yet flushed to HBM. Public because writer
+        backpressure (bench.py) and the solver's flush gate key off it —
+        callers must not reach into ``_pending_rows`` (ADVICE r4)."""
+        return sum(self._pending_rows)
 
     @property
     def steps_added(self) -> int:

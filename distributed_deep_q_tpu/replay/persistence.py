@@ -180,6 +180,16 @@ def load_replay(replay, path: str) -> None:
             _frame_stack_restore(m, z, f"slot{i}_")
         sharded = NamedSharding(replay.mesh, P(AXIS_DP))
         if isinstance(replay, DevicePERFrameReplay):
+            # frame-plane format guard: the round-5 ring is flat padded
+            # int32 (ghost rows, DMA layout) — a file from the old 2-D
+            # uint8 layout has matching capacity/slots but would fail
+            # deep inside shard_map on the next dispatch
+            want = replay.dstate.frames
+            got = z["dev_frames"]
+            assert got.shape == want.shape and got.dtype == want.dtype, (
+                f"frame-plane layout mismatch: file has {got.dtype}"
+                f"{got.shape}, buffer expects {want.dtype}{want.shape} "
+                "(saved by an incompatible version)")
             replicated = NamedSharding(replay.mesh, P())
             replay.dstate = replay.dstate.replace(**{
                 k: jax.device_put(z[f"dev_{k}"],

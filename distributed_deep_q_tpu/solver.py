@@ -127,19 +127,20 @@ class Solver:
         of per-step host overhead. Returns metrics stacked ``[chain]``
         (device arrays — convert only when logging)."""
         chain = chain or max(int(self.config.replay.fused_chain), 1)
-        if any(replay._pending_rows):
+        if replay.pending_rows():
             replay.flush()  # device rows must cover everything the host
             # bookkeeping (cursors/sizes below) claims is written
         cursors, sizes = replay.device_inputs()
         betas = replay.next_betas(chain)
         spec = self._dp_spec
         if spec is None or self._dp_spec_replay is not replay:
-            spec = (replay.slot_cap, replay.stack, replay.n_step,
+            spec = (replay.slot_cap, replay.slot_pad, replay.rowb,
+                    replay._row_len, replay.stack, replay.n_step,
                     replay.gamma, tuple(replay.frame_shape),
                     self.config.replay.batch_size // replay.num_shards,
                     float(self.config.replay.priority_alpha),
                     float(self.config.replay.priority_eps),
-                    replay.num_shards)
+                    replay.num_shards, replay._interpret)
             self._dp_spec, self._dp_spec_replay = spec, replay
         keys = self._next_sample_keys(replay.num_shards, chain)
         self.state, prio, maxp, metrics = \
@@ -150,24 +151,40 @@ class Solver:
         return dict(metrics)
 
     def _next_sample_keys(self, num_shards: int, chain: int) -> np.ndarray:
-        """Counter-derived device-sampling keys ``[D, chain, 2]``: Philox
-        keyed on the config seed with the counter anchored at the train
-        step the fused path FIRST ran from (read once — never per step:
-        ``int(state.step)`` is a D2H sync). A resumed run therefore
-        continues the key sequence instead of replaying it from the start,
-        and two replay geometries sharing this solver never correlate."""
+        """Counter-derived device-sampling keys ``[D, chain, 2]``, anchored
+        at the train step the fused path FIRST ran from (read once — never
+        per step: ``int(state.step)`` is a D2H sync). Key (i, s) is a pure
+        function of (config seed, global step index, shard): a chain=k
+        chunk draws byte-identical keys to k single-step dispatches, a
+        resumed run continues the sequence instead of replaying it, and
+        two replay geometries sharing this solver never correlate.
+
+        One vectorized splitmix64 pass over the whole chunk (the r4 code
+        built a Philox ``Generator`` per step in a Python loop — O(chain)
+        host objects on the path whose design goal is amortizing host
+        work)."""
         if self._fused_key_base is None:
             self._fused_key_base = int(jax.device_get(self.state.step))
             self._fused_steps_issued = 0
+        steps = (self._fused_key_base + self._fused_steps_issued
+                 + np.arange(chain, dtype=np.uint64))
+        # splitmix64 finalizer over (seed, step, shard) — vectorized,
+        # 64 bits of avalanche per lane, split into the two uint32 halves
+        # jax.random expects
+        lane = (steps[None, :] * np.uint64(num_shards)
+                + np.arange(num_shards, dtype=np.uint64)[:, None])
+        with np.errstate(over="ignore"):
+            x = lane + np.uint64(self.config.train.seed) * np.uint64(
+                0x9E3779B97F4A7C15)
+            x = (x + np.uint64(0x9E3779B97F4A7C15))
+            x ^= x >> np.uint64(30)
+            x *= np.uint64(0xBF58476D1CE4E5B9)
+            x ^= x >> np.uint64(27)
+            x *= np.uint64(0x94D049BB133111EB)
+            x ^= x >> np.uint64(31)
         out = np.empty((num_shards, chain, 2), np.uint32)
-        for i in range(chain):
-            # one counter per grad step (not per chunk): a chain=k chunk
-            # draws byte-identical keys to k single-step dispatches
-            ctr = self._fused_key_base + self._fused_steps_issued + i
-            gen = np.random.Generator(np.random.Philox(
-                key=self.config.train.seed, counter=ctr << 128))
-            out[:, i, :] = gen.integers(0, 2**32, size=(num_shards, 2),
-                                        dtype=np.uint32)
+        out[..., 0] = (x >> np.uint64(32)).astype(np.uint32)
+        out[..., 1] = (x & np.uint64(0xFFFFFFFF)).astype(np.uint32)
         self._fused_steps_issued += chain
         return out
 
@@ -239,7 +256,10 @@ class FusedStepStream:
         ``fused_chain`` to avoid it.
         """
         if self._pending == 0:
-            self._len = min(self.chain, max(int(steps_left), 1))
+            assert int(steps_left) >= 1, (
+                f"steps_left={steps_left}: dispatching with a non-positive "
+                "budget would silently run an extra optimizer step")
+            self._len = min(self.chain, int(steps_left))
             phase = (self._timer.phase("dispatch") if self._timer
                      else contextlib.nullcontext())
             with self._lock, phase:

