@@ -504,6 +504,19 @@ def bench_r2d2(cfg_mod, on_cpu: bool, out: dict) -> None:
     out["r2d2_device_vs_host"] = round(
         out["r2d2_device_steps_per_s"]
         / max(out["r2d2_host_steps_per_s"], 1e-9), 2)
+
+    # chained fused sequence path (round 5): device-side sampling/meta/
+    # priorities, chain grad steps per two-program dispatch — the R2D2
+    # twin of the transition flagship's chained mode (the per-step key
+    # above is capped by the tunnel's ~133/s per-dispatch ceiling)
+    chain_k = 2 if on_cpu else 8
+
+    def dev_chained():
+        return solver.train_steps_device_per(dev, chain=chain_k)
+
+    out["r2d2_chained_steps_per_s"] = round(
+        time_loop(dev_chained, max(iters_dev // chain_k, 2)) * chain_k, 2)
+    out["r2d2_chained_chain_k"] = chain_k
     del dev, solver
 
 

@@ -167,10 +167,12 @@ class _ActorComms:
             try:
                 self._client.call("heartbeat")
                 backoff = period
-            except (ConnectionError, OSError):
-                # server gone or mid-restart: back off (cap ~8×period) and
-                # keep trying — the env loop discovers a dead learner on
-                # its own wire calls
+            except (ConnectionError, OSError, ValueError):
+                # server gone, mid-restart, or stream desync (recv_msg
+                # raises ValueError on a bad frame; the client already
+                # dropped the socket so the next call reconnects): back
+                # off (cap ~8×period) and keep trying — the env loop
+                # discovers a dead learner on its own wire calls
                 backoff = min(backoff * 2, period * 8)
             except Exception as e:  # noqa: BLE001 — protocol desync etc.
                 import logging
@@ -799,8 +801,14 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
         solver.state, _ = ckpt.restore(solver.state)
         server.publish_params(solver.get_weights())
 
+    # fused chained sequence path (round 5): sampling/meta/pixels/
+    # priorities on device, chain grad steps per dispatch — the sequence
+    # twin of the transition loop's fused_per branch above.
+    # Prioritized-only (the device sampler draws from the priority row)
+    fused_seq = (device_seq and cfg.replay.device_per
+                 and cfg.replay.prioritized)
     writeback = None
-    if replay.prioritized:
+    if replay.prioritized and not fused_seq:
         from distributed_deep_q_tpu.replay.prioritized import make_writeback
         writeback = make_writeback(replay, cfg.replay,
                                    lock=server.replay_lock,
@@ -814,8 +822,16 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
             # collective learn gate — see train_distributed
             while not all_processes_ready(replay.ready(learn_start_seqs)):
                 time.sleep(0.05)
+        fused_stream = None
+        if fused_seq:
+            from distributed_deep_q_tpu.solver import FusedStepStream
+            fused_stream = FusedStepStream(solver, replay,
+                                           cfg.replay.fused_chain,
+                                           dispatch_lock=server.replay_lock)
         for gstep in range(1, cfg.train.total_steps + 1):
-            if device_seq:
+            if fused_seq:
+                m = fused_stream.next(cfg.train.total_steps - gstep + 1)
+            elif device_seq:
                 # sample AND dispatch under the lock: a concurrent RPC
                 # flush donates the ring buffer, so the gather program
                 # must be enqueued before the handle can be invalidated
@@ -831,7 +847,7 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
                 m = solver.train_step(batch)
             metrics.count("grad_steps")
 
-            if replay.prioritized:
+            if writeback is not None:
                 writeback.push(m["index"], m["td_abs"], sampled_at)
 
             if gstep % cfg.actors.param_sync_period == 0:

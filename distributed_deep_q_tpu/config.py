@@ -114,6 +114,15 @@ class TrainConfig:
     seed: int = 0
     # use the fused Pallas TD-loss kernel on TPU
     use_pallas_loss: bool = False
+    # batch the online net's s and s' forwards into ONE conv application
+    # (Double-DQN only): halves per-step weight reads and doubles conv
+    # batch (MXU utilization) at the cost of saving s' activations for
+    # the (zero-cotangent) backward — wins when the step is weight-read
+    # bound (small batch), loses nothing measurable at large batch
+    fuse_double_forward: bool = False
+    # store Adam's first moment in bfloat16 (optax mu_dtype): trims
+    # optimizer-state HBM traffic on the HBM-bound small-batch step
+    adam_mu_dtype: str = "float32"  # float32 | bfloat16
     checkpoint_dir: str = ""
     checkpoint_every: int = 0  # grad steps between Orbax snapshots
     resume: bool = False       # restore newest snapshot before training

@@ -39,7 +39,8 @@ from distributed_deep_q_tpu.config import ReplayConfig, TrainConfig
 from distributed_deep_q_tpu.ops.losses import (
     sequence_bellman_targets, sequence_dqn_loss)
 from distributed_deep_q_tpu.parallel.learner import (
-    TrainState, make_optimizer, refresh_target)
+    TrainState, clip_grads, fused_adam_step, make_optimizer,
+    refresh_target)
 from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
 from distributed_deep_q_tpu.parallel.multihost import (
     global_batch, put_replicated)
@@ -58,8 +59,10 @@ class SequenceLearner:
         self._replicated = NamedSharding(mesh, P())
         self._batch_sharding = NamedSharding(mesh, P(AXIS_DP))
         self._train_step = self._build_train_step()
-        # device-sequence-ring steps, keyed on (seq_len, stack, frame_shape)
+        # device-sequence-ring steps, keyed on ring geometry
         self._ring_steps: dict[tuple, Any] = {}
+        # fused chained sequence steps, keyed on (spec, chain)
+        self._fused_steps: dict[tuple, Any] = {}
 
     def init_state(self, params: Any) -> TrainState:
         state = TrainState(
@@ -119,9 +122,15 @@ class SequenceLearner:
             loss = lax.pmean(loss, AXIS_DP)
             q_mean = lax.pmean(jnp.mean(q), AXIS_DP)
 
-            updates, opt_state = opt.update(grads, state.opt_state,
-                                            state.params)
-            params = optax.apply_updates(state.params, updates)
+            gnorm = optax.global_norm(grads)
+            if cfg.optimizer == "adam":
+                opt_state, params = fused_adam_step(
+                    cfg, grads, state.opt_state, state.params, gnorm)
+            else:
+                grads, gnorm = clip_grads(cfg, grads, gnorm)
+                updates, opt_state = opt.update(grads, state.opt_state,
+                                                state.params)
+                params = optax.apply_updates(state.params, updates)
             step = state.step + 1
             target_params = refresh_target(cfg, params, state.target_params,
                                            step)
@@ -129,7 +138,7 @@ class SequenceLearner:
             metrics = {
                 "loss": loss,
                 "q_mean": q_mean,
-                "grad_norm": optax.global_norm(grads),
+                "grad_norm": gnorm,
             }
             return new_state, metrics, priority
 
@@ -146,31 +155,40 @@ class SequenceLearner:
         return jax.jit(sharded, donate_argnums=0)
 
     def _build_ring_step(self, geom: tuple):
-        """R2D2 step fed by the device-resident sequence ring
-        (replay/device_sequence.py): TWO programs, mirroring the fused
-        transition path's measured layout discipline — the SAMPLE program
-        gathers the [b, T+1, stack] window rows from the local ring shard
-        and returns them flat (gather-natural); the TRAIN program reshapes
-        to [b, T+1, H, W, S] and runs the recurrent step. Pixels never
-        cross the host boundary per step — only KB-scale metadata does."""
-        seq_len, stack, frame_shape = geom
+        """Per-step R2D2 ring path (host-sampled indices): the SAMPLE
+        program DMA-copies each drawn sequence's contiguous W-row block
+        out of the flat padded ring (``ops/ring_gather.py`` — one DMA per
+        sequence, no gather lowering); the TRAIN program slices the
+        stacked observations out of the blocks (static slices,
+        ``compose_sequence_block``) and runs the recurrent step. Pixels
+        never cross the host boundary per step — only KB-scale metadata
+        does. The CHAINED path (``_build_fused_steps``) is the
+        throughput mode; this one serves host-tree PER and the
+        RPC-driven per-step loops."""
+        (seq_len, stack, frame_shape, W, rowb, row_len, per_shard,
+         interpret) = geom
+        from distributed_deep_q_tpu.ops.ring_gather import gather_windows
         from distributed_deep_q_tpu.replay.device_sequence import (
-            compose_sequence_rows)
+            compose_sequence_block)
 
         S = P(AXIS_DP)
+        rowp = rowb // 4
 
-        def sample_fn(ring, seq_local, n_valid):
-            return compose_sequence_rows(ring, seq_local, n_valid,
-                                         seq_len, stack)
+        def sample_fn(ring, seq_local):
+            win = gather_windows(seq_local * W, ring, n=per_shard, w=W,
+                                 rowb=rowb, interpret=interpret)
+            return win.reshape(per_shard, W, rowp)
 
         sample = jax.jit(shard_map(
-            sample_fn, mesh=self.mesh, in_specs=(S, S, S), out_specs=S,
+            sample_fn, mesh=self.mesh, in_specs=(S, S), out_specs=S,
             check_vma=False))
 
-        def train_fn(state: TrainState, rows, batch):
+        def train_fn(state: TrainState, block, batch):
             h, w = frame_shape
-            obs = rows.reshape(rows.shape[:3] + (h, w))
             batch = dict(batch)
+            obs = compose_sequence_block(block, batch["mask"], seq_len,
+                                         stack, row_len)
+            obs = obs.reshape(obs.shape[:3] + (h, w))
             batch["obs"] = jnp.moveaxis(obs, 2, -1)  # [b, T+1, H, W, S]
             return self._step_core(state, batch)
 
@@ -181,20 +199,129 @@ class SequenceLearner:
             check_vma=False), donate_argnums=0)
         return sample, train
 
-    def train_step_from_ring(self, state: TrainState, ring, batch,
-                             seq_len: int, stack: int,
-                             frame_shape: tuple[int, int]):
+    def train_step_from_ring(self, state: TrainState, replay, batch):
         """One DP step composing sequence pixels from the HBM ring; returns
         (state, metrics, per-sequence priority [B])."""
-        geom = (int(seq_len), int(stack), tuple(frame_shape))
+        b = len(batch["seq_local"])
+        geom = (replay.seq_len, replay.stack, tuple(replay.frame_shape),
+                replay.W, replay.rowb, replay._row_len,
+                b // replay.num_shards, replay._interpret)
         if geom not in self._ring_steps:
             self._ring_steps[geom] = self._build_ring_step(geom)
         sample, train = self._ring_steps[geom]
-        rows = sample(ring, np.asarray(batch["seq_local"], np.int32),
-                      np.asarray(batch["n_valid"], np.int32))
+        rows = sample(replay.ring, np.asarray(batch["seq_local"], np.int32))
         meta = {k: v for k, v in batch.items()
                 if k not in ("seq_local", "n_valid")}
         return train(state, rows, meta)
+
+    def _build_fused_steps(self, spec: tuple, chain: int):
+        """Chained fused sequence steps — the transition path's two-program
+        structure (``Learner._build_device_per_step``) on the sequence
+        ring: the SAMPLE program draws all ``chain`` sequence batches
+        against chunk-start priorities (inverse-CDF over the device
+        priority row), row-gathers their metadata, and DMA-copies each
+        sequence's contiguous W-row pixel block; the TRAIN program scans
+        the ``chain`` recurrent steps with same-step per-sequence
+        priority scatters. Per chunk the host ships per-shard sizes, βs,
+        and keys — nothing reads back. Per-step dispatch caps at ~133/s
+        on this runtime (PERF §2, measured 50.6/s for the r4 sequence
+        path); chaining is what lifts the R2D2 device path past it."""
+        (caps_local, seq_len, stack, W, rowb, row_len, frame_shape,
+         per_shard, alpha, eps, num_shards, interpret) = spec
+        from distributed_deep_q_tpu.ops.ring_gather import gather_windows
+        from distributed_deep_q_tpu.replay.device_per import (
+            build_cdf, draw_from_cdf, scatter_priorities,
+            stratified_is_weights)
+        from distributed_deep_q_tpu.replay.device_sequence import (
+            compose_sequence_block)
+
+        S = P(AXIS_DP)
+        SK = P(None, AXIS_DP)
+        SK3 = P(None, AXIS_DP, None)
+        SWIN = P(None, AXIS_DP, None, None)
+        rowp = rowb // 4
+        n_win = chain * per_shard
+
+        def sample_fn(keys, ring, dmeta, sizes, betas):
+            filled = (jnp.arange(caps_local) < sizes[0]).astype(
+                jnp.float32)
+            pm = dmeta["prio"] * filled
+            cdf, mass = build_cdf(pm)
+            n_glob = lax.psum(jnp.sum(filled), AXIS_DP)
+            idx, p = jax.vmap(
+                lambda k: draw_from_cdf(k, cdf, pm, mass, per_shard))(
+                keys[0])                               # [chain, b]
+            flat = idx.reshape(-1)
+            metas = {key: dmeta[key][flat].reshape(
+                (chain, per_shard) + dmeta[key].shape[1:])
+                for key in ("action", "reward", "discount", "mask",
+                            "init_c", "init_h")}
+            metas["weight"] = stratified_is_weights(p, mass, n_glob,
+                                                    betas, num_shards)
+            win = gather_windows(flat * W, ring, n=n_win, w=W, rowb=rowb,
+                                 interpret=interpret)
+            idx = jnp.where(mass > 0, idx, caps_local)
+            return (metas, win.reshape(chain, per_shard, W, rowp),
+                    idx.astype(jnp.int32))
+
+        meta_spec = {"action": SK3, "reward": SK3, "discount": SK3,
+                     "mask": SK3, "init_c": SK3, "init_h": SK3,
+                     "weight": SK}
+        dmeta_spec = {k: S for k in ("action", "reward", "discount",
+                                     "mask", "init_c", "init_h", "prio")}
+        sample = jax.jit(shard_map(
+            sample_fn, mesh=self.mesh,
+            in_specs=(S, S, dmeta_spec, S, P()),
+            out_specs=(meta_spec, SWIN, SK),
+            check_vma=False))
+
+        def train_fn(state: TrainState, metas, win, idxs, prio, maxp):
+            h, wd = frame_shape
+
+            def body(carry, xs):
+                state, prio, maxp = carry
+                batch, block, idx = xs
+                batch = dict(batch)
+                obs = compose_sequence_block(block, batch["mask"],
+                                             seq_len, stack, row_len)
+                obs = obs.reshape(obs.shape[:3] + (h, wd))
+                batch["obs"] = jnp.moveaxis(obs, 2, -1)
+                state, metrics, priority = self._step_core(state, batch)
+                prio, maxp = scatter_priorities(prio, maxp, idx, priority,
+                                                alpha, eps)
+                return (state, prio, maxp), metrics
+
+            (state, prio, maxp), metrics = lax.scan(
+                body, (state, prio, maxp), (metas, win, idxs))
+            return state, prio, maxp, metrics
+
+        train = jax.jit(shard_map(
+            train_fn, mesh=self.mesh,
+            in_specs=(P(), meta_spec, SWIN, SK, S, P()),
+            out_specs=(P(), S, P(), P()),
+            check_vma=False), donate_argnums=(0, 4, 5))
+        return sample, train
+
+    def train_steps_fused(self, state: TrainState, replay, batch_size: int,
+                          sizes, betas: np.ndarray, keys: np.ndarray):
+        """``len(betas)`` fused sequence steps in one two-program dispatch.
+        Returns (state, new prio, new maxp, metrics stacked [chain])."""
+        chain = len(betas)
+        spec = (replay.caps_local, replay.seq_len, replay.stack, replay.W,
+                replay.rowb, replay._row_len, tuple(replay.frame_shape),
+                batch_size // replay.num_shards,
+                replay.alpha, replay.eps, replay.num_shards,
+                replay._interpret)
+        cache_key = (spec, chain)
+        if cache_key not in self._fused_steps:
+            self._fused_steps[cache_key] = self._build_fused_steps(
+                spec, chain)
+        sample, train = self._fused_steps[cache_key]
+        metas, win, idx = sample(keys, replay.ring, replay.dmeta,
+                                 np.asarray(sizes),
+                                 np.asarray(betas, np.float32))
+        return train(state, metas, win, idx, replay.dmeta["prio"],
+                     replay.dmaxp)
 
     def train_step(self, state: TrainState, batch: dict[str, Any]):
         """One synchronous DP step over a [B, T_total(+1)] sequence batch;
@@ -237,6 +364,9 @@ class SequenceSolver:
         self._strip = _strip_host_keys
         self._fwd = jax.jit(
             lambda p, o, c: self.module.apply({"params": p}, o, c))
+        # fused chained-path key bookkeeping (Solver's scheme)
+        self._fused_key_base: int | None = None
+        self._fused_steps_issued = 0
 
     @property
     def step(self) -> int:
@@ -257,13 +387,35 @@ class SequenceSolver:
         sequence ring (``DeviceSequenceReplay``): ``batch`` carries only
         sequence metadata + shard-local slot indices."""
         self.state, metrics, priority = self.learner.train_step_from_ring(
-            self.state, replay.ring, self._strip(batch), replay.seq_len,
-            replay.stack, replay.frame_shape)
+            self.state, replay, self._strip(batch))
         out: dict[str, Any] = dict(metrics)
         out["td_abs"] = priority
         if "index" in batch:
             out["index"] = batch["index"]
         return out
+
+    def train_steps_device_per(self, replay,
+                               chain: int | None = None) -> dict[str, Any]:
+        """``chain`` fused sequence steps in ONE two-program dispatch
+        (sampling, metadata, pixels, and per-sequence priority updates all
+        on device — ``SequenceLearner._build_fused_steps``). Same protocol
+        as ``Solver.train_steps_device_per`` so ``FusedStepStream`` drives
+        either. Returns metrics stacked [chain]."""
+        from distributed_deep_q_tpu.solver import next_fused_keys
+
+        chain = chain or max(int(self.config.replay.fused_chain), 1)
+        if replay.pending_rows():
+            replay.flush()
+        sizes = replay.device_inputs()
+        betas = replay.next_betas(chain)
+        keys = next_fused_keys(self, replay.num_shards, chain)
+        self.state, prio, maxp, metrics = self.learner.train_steps_fused(
+            self.state, replay, self.config.replay.batch_size, sizes,
+            betas, keys)
+        replay.dmeta = dict(replay.dmeta)
+        replay.dmeta["prio"] = prio
+        replay.dmaxp = maxp
+        return dict(metrics)
 
     # -- recurrent actor path ----------------------------------------------
 

@@ -292,27 +292,40 @@ def fused_sample_draw_many(keys: jax.Array,
     oflat, ovalid, nflat, nvalid = (
         x.reshape(lead + x.shape[1:])
         for x in (oflat, ovalid, nflat, nvalid))
-    # IS weights for the realized stratified draw: P(i) = p_i/(D·mass_s)
-    # (each shard contributes exactly per_shard draws — matches the host
-    # path's DeviceFrameReplay.sample weight math), N = global sampleable
-    # transition count (``n_glob``, psum'd once per chunk in prep). Each
-    # chain row normalizes against ITS step's cross-shard max.
-    pr = jnp.maximum(p / num_shards, 1e-12)
-    w = (n_glob * pr) ** (-betas[:, None])
-    # a shard whose masked priority mass is zero (e.g. its only sampleable
-    # slot sealed away post-warmup) would otherwise compose garbage rows
-    # with extreme weights: zero those weights and point the priority
-    # scatter out of bounds (dropped), so the degenerate shard contributes
-    # nothing — the host path raises instead; here the step stays total.
-    # Masking must precede the pmax: a dead shard's floored p=1e-12 blows
-    # w up to ~1e4, and normalizing live shards by THAT w_max would crush
-    # the whole batch's learning signal.
-    w = jnp.where(mass > 0, w, 0.0)
-    w_max = lax.pmax(jnp.max(w, axis=1), "dp")             # [chain]
-    meta["weight"] = (w / jnp.maximum(w_max[:, None], 1e-12)
-                      ).astype(jnp.float32)
+    meta["weight"] = stratified_is_weights(p, mass, n_glob, betas,
+                                           num_shards)
     idx = jnp.where(mass > 0, idx, pm.shape[0])
     return meta, oflat, ovalid, nflat, nvalid, idx.astype(jnp.int32)
+
+
+def stratified_is_weights(p: jax.Array, mass: jax.Array,
+                          n_glob: jax.Array, betas: jax.Array,
+                          num_shards: int) -> jax.Array:
+    """IS weights for the realized per-shard stratified draw, normalized
+    per chain row — THE single copy of this math, shared by the
+    transition samplers (reference and packed) and the fused sequence
+    sampler. ``p`` [chain, B] draw probabilities (p_i/mass),
+    ``betas`` [chain]; runs inside shard_map (``lax.pmax`` over 'dp').
+
+    P(i) = p_i/(D·mass_s) — each shard contributes exactly B/D draws,
+    matching the host path's weight math; N = global sampleable count
+    (``n_glob``, psum'd once per chunk).
+
+    A shard whose masked priority mass is zero (e.g. its only sampleable
+    slot sealed away post-warmup) would otherwise compose garbage rows
+    with extreme weights: zero those weights (the caller points its
+    priority scatter out of bounds), so the degenerate shard contributes
+    nothing — the host path raises instead; here the step stays total.
+    Masking must precede the pmax: a dead shard's floored p=1e-12 blows
+    w up to ~1e4, and normalizing live shards by THAT w_max would crush
+    the whole batch's learning signal."""
+    from jax import lax
+
+    pr = jnp.maximum(p / num_shards, 1e-12)
+    w = (n_glob * pr) ** (-betas[:, None])
+    w = jnp.where(mass > 0, w, 0.0)
+    w_max = lax.pmax(jnp.max(w, axis=1), "dp")             # [chain]
+    return (w / jnp.maximum(w_max[:, None], 1e-12)).astype(jnp.float32)
 
 
 def build_meta_pack(action: jax.Array, reward: jax.Array, done: jax.Array,
@@ -392,15 +405,8 @@ def fused_sample_draw_packed(keys: jax.Array, pack: jax.Array,
         "ovalid": mp[..., 3:3 + stack].astype(jnp.uint8),
         "nvalid": mp2[..., 3:3 + stack].astype(jnp.uint8),
     }
-    # IS weights — same math and dead-shard handling as
-    # fused_sample_draw_many (see the comments there; masking must
-    # precede the pmax)
-    pr = jnp.maximum(p / num_shards, 1e-12)
-    w = (n_glob * pr) ** (-betas[:, None])
-    w = jnp.where(mass > 0, w, 0.0)
-    w_max = lax.pmax(jnp.max(w, axis=1), "dp")
-    meta["weight"] = (w / jnp.maximum(w_max[:, None], 1e-12)
-                      ).astype(jnp.float32)
+    meta["weight"] = stratified_is_weights(p, mass, n_glob, betas,
+                                           num_shards)
     # window start (padded coords): rows [local-stack+1 .. local+n_step]
     # are contiguous there thanks to the ghost rows — always in bounds
     # (slot_pad = slot_cap + window - 1)

@@ -25,6 +25,50 @@ from distributed_deep_q_tpu.parallel.learner import Learner, TrainState
 from distributed_deep_q_tpu.parallel.mesh import make_mesh
 
 
+def sample_key_schedule(seed: int, start_step: int, num_shards: int,
+                        chain: int) -> np.ndarray:
+    """Device-sampling keys ``[D, chain, 2]`` for grad steps
+    ``start_step .. start_step+chain``: key (i, s) is a pure function of
+    (seed, global step index, shard), so a chain=k chunk draws
+    byte-identical keys to k single-step dispatches, a resumed run
+    continues the sequence instead of replaying it, and two replay
+    geometries never correlate. One vectorized splitmix64 pass (the r4
+    code built a Philox ``Generator`` per step in a Python loop)."""
+    steps = start_step + np.arange(chain, dtype=np.uint64)
+    lane = (steps[None, :] * np.uint64(num_shards)
+            + np.arange(num_shards, dtype=np.uint64)[:, None])
+    with np.errstate(over="ignore"):
+        x = lane + np.uint64(seed) * np.uint64(0x9E3779B97F4A7C15)
+        x = (x + np.uint64(0x9E3779B97F4A7C15))
+        x ^= x >> np.uint64(30)
+        x *= np.uint64(0xBF58476D1CE4E5B9)
+        x ^= x >> np.uint64(27)
+        x *= np.uint64(0x94D049BB133111EB)
+        x ^= x >> np.uint64(31)
+    out = np.empty((num_shards, chain, 2), np.uint32)
+    out[..., 0] = (x >> np.uint64(32)).astype(np.uint32)
+    out[..., 1] = (x & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return out
+
+
+def next_fused_keys(owner, num_shards: int, chain: int) -> np.ndarray:
+    """``sample_key_schedule`` with the owner's anchoring bookkeeping —
+    THE single copy of the fused paths' key-state logic, shared by
+    ``Solver`` and ``SequenceSolver``. Anchors at the train step the
+    fused path FIRST ran from, read once — never per step
+    (``int(state.step)`` is a D2H sync) — so a resumed run continues the
+    key sequence instead of replaying it."""
+    if owner._fused_key_base is None:
+        owner._fused_key_base = int(jax.device_get(owner.state.step))
+        owner._fused_steps_issued = 0
+    out = sample_key_schedule(
+        owner.config.train.seed,
+        owner._fused_key_base + owner._fused_steps_issued,
+        num_shards, chain)
+    owner._fused_steps_issued += chain
+    return out
+
+
 def _strip_host_keys(batch: dict[str, Any]) -> dict[str, Any]:
     """Drop host-only bookkeeping (slot indices, sample snapshots) before a
     batch crosses into the jitted step."""
@@ -151,42 +195,7 @@ class Solver:
         return dict(metrics)
 
     def _next_sample_keys(self, num_shards: int, chain: int) -> np.ndarray:
-        """Counter-derived device-sampling keys ``[D, chain, 2]``, anchored
-        at the train step the fused path FIRST ran from (read once — never
-        per step: ``int(state.step)`` is a D2H sync). Key (i, s) is a pure
-        function of (config seed, global step index, shard): a chain=k
-        chunk draws byte-identical keys to k single-step dispatches, a
-        resumed run continues the sequence instead of replaying it, and
-        two replay geometries sharing this solver never correlate.
-
-        One vectorized splitmix64 pass over the whole chunk (the r4 code
-        built a Philox ``Generator`` per step in a Python loop — O(chain)
-        host objects on the path whose design goal is amortizing host
-        work)."""
-        if self._fused_key_base is None:
-            self._fused_key_base = int(jax.device_get(self.state.step))
-            self._fused_steps_issued = 0
-        steps = (self._fused_key_base + self._fused_steps_issued
-                 + np.arange(chain, dtype=np.uint64))
-        # splitmix64 finalizer over (seed, step, shard) — vectorized,
-        # 64 bits of avalanche per lane, split into the two uint32 halves
-        # jax.random expects
-        lane = (steps[None, :] * np.uint64(num_shards)
-                + np.arange(num_shards, dtype=np.uint64)[:, None])
-        with np.errstate(over="ignore"):
-            x = lane + np.uint64(self.config.train.seed) * np.uint64(
-                0x9E3779B97F4A7C15)
-            x = (x + np.uint64(0x9E3779B97F4A7C15))
-            x ^= x >> np.uint64(30)
-            x *= np.uint64(0xBF58476D1CE4E5B9)
-            x ^= x >> np.uint64(27)
-            x *= np.uint64(0x94D049BB133111EB)
-            x ^= x >> np.uint64(31)
-        out = np.empty((num_shards, chain, 2), np.uint32)
-        out[..., 0] = (x >> np.uint64(32)).astype(np.uint32)
-        out[..., 1] = (x & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-        self._fused_steps_issued += chain
-        return out
+        return next_fused_keys(self, num_shards, chain)
 
     # -- inference (actor path) -------------------------------------------
 

@@ -405,13 +405,26 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
                               obs_dtype, cfg.net.lstm_size, cfg.train.gamma)
     learn_start_seqs = max(cfg.replay.learn_start // seq_len, 2)
 
+    # fused chained sequence path: sampling/meta/pixels/priorities all on
+    # device, chain grad steps per dispatch (sequence twin of the
+    # transition path's FusedStepStream loop). Prioritized-only, same
+    # gate as the transition path: the device sampler draws from the
+    # priority row, so a uniform config must keep the per-step path.
+    fused_seq = (device_seq and cfg.replay.device_per
+                 and cfg.replay.prioritized)
+    stream = None
+    if fused_seq:
+        from distributed_deep_q_tpu.solver import FusedStepStream
+        stream = FusedStepStream(solver, replay,
+                                 max(int(cfg.replay.fused_chain), 1))
+
     frame = env.reset()
     obs = stacker.reset(frame) if pixel else frame
     carry = solver.initial_state(1)
     ep_ret, ep_returns = 0.0, MovingAverage(100)
     summary: dict = {}
     writeback = None
-    if replay.prioritized:
+    if replay.prioritized and not fused_seq:
         from distributed_deep_q_tpu.replay.prioritized import make_writeback
         writeback = make_writeback(replay, cfg.replay)
     gsteps = 0
@@ -449,14 +462,19 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
 
         if (replay.ready(learn_start_seqs)
                 and t % cfg.train.train_every == 0):
-            batch = replay.sample(cfg.replay.batch_size)
-            sampled_at = batch.pop("_sampled_at")
-            if device_seq:
-                m = solver.train_step_from_ring(replay, batch)
+            if fused_seq:
+                remaining = ((cfg.train.total_steps - t)
+                             // cfg.train.train_every + 1)
+                m = stream.next(remaining)
             else:
-                m = solver.train_step(batch)
+                batch = replay.sample(cfg.replay.batch_size)
+                sampled_at = batch.pop("_sampled_at")
+                if device_seq:
+                    m = solver.train_step_from_ring(replay, batch)
+                else:
+                    m = solver.train_step(batch)
             gsteps += 1
-            if replay.prioritized:
+            if writeback is not None:
                 writeback.push(m["index"], m["td_abs"], sampled_at)
             metrics.count("grad_steps")
             if ckpt and gsteps % cfg.train.checkpoint_every == 0:

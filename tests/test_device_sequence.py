@@ -100,14 +100,27 @@ def test_device_sequence_sample_matches_host_store():
         np.testing.assert_array_equal(batch[k], getattr(host, k)[hidx],
                                       err_msg=k)
 
-    # compose pixels through the sharded gather program (the real path)
+    # compose pixels through the PRODUCTION path: per-sequence window DMA
+    # (interpret on the CPU mesh) + static-slice stacking
+    from distributed_deep_q_tpu.ops.ring_gather import gather_windows
+    from distributed_deep_q_tpu.replay.device_sequence import (
+        compose_sequence_block)
+
     S = P("dp")
+    per = 16 // dev.num_shards
+    W, rowb, rowp = dev.W, dev.rowb, dev.rowp
+
+    def fn(ring, sl, msk):
+        win = gather_windows(sl * W, ring, n=per, w=W, rowb=rowb,
+                             interpret=True)
+        return compose_sequence_block(win.reshape(per, W, rowp), msk,
+                                      seq_len, stack, dev._row_len)
+
     rows = jax.jit(shard_map(
-        lambda ring, sl, nv: compose_sequence_rows(
-            ring, sl, nv, seq_len, stack),
-        mesh=mesh, in_specs=(S, S, S), out_specs=S, check_vma=False))(
+        fn, mesh=mesh, in_specs=(S, S, S), out_specs=S,
+        check_vma=False))(
         dev.ring, jnp.asarray(batch["seq_local"]),
-        jnp.asarray(batch["n_valid"]))
+        jnp.asarray(batch["mask"]))
     got = np.moveaxis(
         np.asarray(rows).reshape(16, seq_len + 1, stack, 6, 6), 2, -1)
     np.testing.assert_array_equal(got, host.obs[hidx])
@@ -145,9 +158,32 @@ def test_recurrent_ring_step_end_to_end():
     summary = train_recurrent(cfg, log_every=10)
     assert np.isfinite(summary["loss"])
     assert summary["solver"].step >= 10
-    from distributed_deep_q_tpu.replay.device_sequence import (
-        DeviceSequenceReplay as DSR)
-    del DSR
+
+
+def test_recurrent_fused_chained_end_to_end():
+    """The round-5 fused sequence path (device_per=true): sampling,
+    metadata, pixels, and per-sequence priorities all on device, chain
+    grad steps per dispatch — finite losses, exact step total, priorities
+    moved off the fresh seed."""
+    from distributed_deep_q_tpu.train import train_recurrent
+
+    cfg = Config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.dp = 2
+    cfg.env = EnvConfig(id="signal", kind="signal_atari",
+                        frame_shape=(36, 36), stack=4, reward_clip=0.0)
+    cfg.net = NetConfig(kind="r2d2", num_actions=4, frame_shape=(36, 36),
+                        stack=4, lstm_size=16, compute_dtype="float32")
+    cfg.replay = ReplayConfig(capacity=4096, batch_size=8, learn_start=256,
+                              sequence_length=16, burn_in=4,
+                              prioritized=True, device_resident=True,
+                              device_per=True, fused_chain=3)
+    cfg.train = TrainConfig(lr=1e-3, total_steps=500, train_every=16,
+                            target_update_period=10, seed=0,
+                            eval_episodes=1)
+    summary = train_recurrent(cfg, log_every=10)
+    assert np.isfinite(summary["loss"])
+    assert summary["solver"].step >= 10
 
 
 @pytest.mark.slow

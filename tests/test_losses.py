@@ -58,3 +58,49 @@ def test_sequence_loss_masking():
     np.testing.assert_allclose(float(loss), 0.5)
     # priority = 0.9*max + 0.1*mean = 0.9*1 + 0.1*1 = 1.0 for both
     np.testing.assert_allclose(np.asarray(prio), [1.0, 1.0])
+
+
+def test_fused_adam_matches_optax_chain():
+    """fused_adam_step == optax.chain(clip_by_global_norm, adam) over
+    several steps on a ragged param tree — values AND state structure
+    (checkpoints must stay interchangeable)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_deep_q_tpu.config import TrainConfig
+    from distributed_deep_q_tpu.parallel.learner import fused_adam_step
+
+    cfg = TrainConfig(lr=3e-3, adam_eps=1e-5, grad_clip_norm=0.7)
+    rng = np.random.default_rng(0)
+    params = {
+        "conv": {"kernel": jnp.asarray(rng.standard_normal((3, 3, 4, 8)),
+                                       jnp.float32),
+                 "bias": jnp.asarray(rng.standard_normal(8), jnp.float32)},
+        "fc": jnp.asarray(rng.standard_normal((16, 4)), jnp.float32),
+    }
+    ref_opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm),
+                          optax.adam(cfg.lr, eps=cfg.adam_eps))
+    my_state = optax.adam(cfg.lr, eps=cfg.adam_eps).init(params)
+    # clip state is EmptyState: adam().init's structure matches position 0
+    ref_state = ref_opt.init(params)
+    my_params = ref_params = params
+    for i in range(4):
+        grads = jax.tree.map(
+            lambda p: jnp.asarray(rng.standard_normal(p.shape) * (10 if
+                                  i == 1 else 0.1), jnp.float32), params)
+        upd, ref_state = ref_opt.update(grads, ref_state, ref_params)
+        ref_params = optax.apply_updates(ref_params, upd)
+        gnorm = optax.global_norm(grads)
+        my_state, my_params = fused_adam_step(cfg, grads, my_state,
+                                              my_params, gnorm)
+    for a, b in zip(jax.tree.leaves(my_params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-6,
+                                   atol=1e-7)
+    # moments too (position 0 of the state tuple holds ScaleByAdamState in
+    # both; ref_state position 1 is the clip's EmptyState vs adam's tail —
+    # values are what matter)
+    for a, b in zip(jax.tree.leaves(my_state[0].mu),
+                    jax.tree.leaves(ref_state[1][0].mu)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-6,
+                                   atol=1e-7)
