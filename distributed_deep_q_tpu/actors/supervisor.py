@@ -65,7 +65,7 @@ def _probe_envs(cfg: Config):
 
 
 def _split_fleet_across_processes(cfg: Config, pixel: bool, metrics,
-                                  ring_desc: str):
+                                  ring_desc: str, fused_ok: bool = False):
     """Config 5 FULL shape (SURVEY §7.3 item 6): every learner process runs
     its own ReplayFeed server + actor slice + replay shard; each samples
     its batch/pc local rows into the train step, whose pmean spans hosts
@@ -93,12 +93,18 @@ def _split_fleet_across_processes(cfg: Config, pixel: bool, metrics,
         if cfg.actors.num_actors % pc:
             raise ValueError(f"actors.num_actors={cfg.actors.num_actors} "
                              f"must divide across {pc} processes")
-        if pixel and cfg.replay.device_resident:
+        if pixel and cfg.replay.device_resident and not (
+                fused_ok and cfg.replay.prioritized
+                and cfg.replay.device_per):
+            hint = ("the FUSED ring (replay.prioritized=true + "
+                    "replay.device_per=true — per-host staging into the "
+                    "global mesh ring, lockstep flush) or " if fused_ok
+                    else "")
             raise ValueError(
-                f"the {ring_desc} is single-controller; multi-host "
-                "--distributed pixel runs need "
-                "replay.device_resident=false (per-host host-RAM shards "
-                "feeding global_batch)")
+                f"the {ring_desc}'s host-sampled path is "
+                f"single-controller; multi-host --distributed pixel runs "
+                f"need either {hint}replay.device_resident=false "
+                "(per-host host-RAM shards feeding global_batch)")
         local_batch = cfg.replay.batch_size // pc
         k = cfg.actors.num_actors // pc
         cfg = cfg.replace(actors=dataclasses.replace(
@@ -558,7 +564,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     from distributed_deep_q_tpu.parallel.multihost import (
         all_processes_ready, local_rows)
     cfg, local_batch, metrics, pc, pid = _split_fleet_across_processes(
-        cfg, pixel, metrics, "mesh-sharded HBM ring")
+        cfg, pixel, metrics, "mesh-sharded HBM ring", fused_ok=True)
     from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
     if pixel and cfg.replay.device_resident:
         # fused device PER (prioritized + device_per): the learner step
@@ -724,6 +730,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     summary["env_steps"] = server.env_steps
     summary["actor_restarts"] = sup.restarts
     summary["solver"] = solver
+    summary["replay"] = replay
     return summary
 
 
@@ -760,6 +767,8 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
         all_processes_ready, local_rows)
     # config 5 full shape, recurrent edition: per-host server + actor
     # slice + sequence-replay shard
+    # fused_ok=False: DeviceSequenceReplay has no multi-host staging yet —
+    # reject loudly instead of silently falling back to the host store
     cfg, local_batch, metrics, pc, pid = _split_fleet_across_processes(
         cfg, pixel, metrics, "device sequence ring")
     seq_len = cfg.replay.sequence_length

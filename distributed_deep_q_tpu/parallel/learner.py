@@ -46,19 +46,28 @@ class TrainState(flax.struct.PyTreeNode):
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
-    """BARE optimizer (no clip stage). The reference PS applied
-    RMSProp/AdaGrad-style updates (SURVEY §3.4 [P]); we default to Adam
-    with the same switch. Gradient clipping lives in ``clip_grads`` —
-    called by the train steps with the norm they already compute for the
-    ``grad_norm`` metric, instead of ``optax.clip_by_global_norm``'s own
-    second norm pass (measured ~0.05 ms/step at batch 32, ~18% of the
-    whole step — two full tree reads for one piece of information)."""
+    """Optimizer chain. The reference PS applied RMSProp/AdaGrad-style
+    updates (SURVEY §3.4 [P]); we default to Adam with the same switch.
+
+    For adam the returned transform's ``init`` defines the opt_state
+    STRUCTURE (kept exactly as optax builds it, chain included, so
+    checkpoints resume across versions) but its ``update`` is NOT on the
+    hot path — the train steps run ``fused_adam_step``, which performs
+    the same clip+adam math in one tree pass (the optax stack costs
+    ~0.05 ms/step at batch 32 in separate passes — the step is
+    op-count-bound there). rmsprop keeps the optax update path with
+    ``clip_grads``."""
     if cfg.optimizer == "adam":
-        return optax.adam(cfg.lr, eps=cfg.adam_eps,
-                          mu_dtype=jnp.dtype(cfg.adam_mu_dtype))
-    if cfg.optimizer == "rmsprop":
-        return optax.rmsprop(cfg.lr, decay=0.95, eps=1e-2, centered=True)
-    raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+        opt = optax.adam(cfg.lr, eps=cfg.adam_eps,
+                         mu_dtype=jnp.dtype(cfg.adam_mu_dtype))
+    elif cfg.optimizer == "rmsprop":
+        opt = optax.rmsprop(cfg.lr, decay=0.95, eps=1e-2, centered=True)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    if cfg.grad_clip_norm > 0:
+        return optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm),
+                           opt)
+    return opt
 
 
 def clip_grads(cfg: TrainConfig, grads: Any,
@@ -89,7 +98,22 @@ def fused_adam_step(cfg: TrainConfig, grads: Any, opt_state: Any,
 
     Returns (new opt_state, new params).
     """
-    adam_state, tail = opt_state[0], opt_state[1:]
+    # locate the ScaleByAdamState inside whichever structure
+    # make_optimizer built — bare adam (clip off) or
+    # chain(clip_by_global_norm, adam) — preserving it exactly so
+    # checkpoints stay resumable across both
+    if isinstance(opt_state[0], optax.ScaleByAdamState):
+        adam_state = opt_state[0]
+
+        def rebuild(s):
+            return (s,) + tuple(opt_state[1:])
+    else:
+        inner = opt_state[1]
+        adam_state = inner[0]
+
+        def rebuild(s):
+            return (opt_state[0], (s,) + tuple(inner[1:])) \
+                + tuple(opt_state[2:])
     b1, b2 = 0.9, 0.999
     count = optax.safe_increment(adam_state.count)
     c = count.astype(jnp.float32)
@@ -114,9 +138,7 @@ def fused_adam_step(cfg: TrainConfig, grads: Any, opt_state: Any,
         treedef, [t[i] for t in jax.tree_util.tree_leaves(
             out, is_leaf=lambda x: isinstance(x, tuple))])
         for i in range(3))
-    new_state = (adam_state._replace(count=count, mu=mu, nu=nu),) \
-        + tuple(tail)
-    return new_state, params
+    return rebuild(adam_state._replace(count=count, mu=mu, nu=nu)), params
 
 
 def refresh_target(cfg: TrainConfig, params: Any, target_params: Any,
@@ -411,11 +433,16 @@ class Learner:
             self._device_per_steps[cache_key] = \
                 self._build_device_per_step(spec, chain)
         sample, train = self._device_per_steps[cache_key]
+
+        def feed(x, dtype=None):
+            # host numpy feeds pass through asarray; multi-host global
+            # jax arrays (assembled by the solver) must not be copied
+            return x if isinstance(x, jax.Array) else np.asarray(x, dtype)
+
         metas, win, idx = sample(keys, rows.frames, rows.action,
                                  rows.reward, rows.done, rows.boundary,
-                                 rows.prio, np.asarray(cursors),
-                                 np.asarray(sizes),
-                                 np.asarray(betas, np.float32))
+                                 rows.prio, feed(cursors), feed(sizes),
+                                 feed(betas, np.float32))
         return train(state, metas, win, idx, rows.prio, rows.maxp)
 
     def train_step(self, state: TrainState, batch: dict[str, Any]):

@@ -135,6 +135,21 @@ def all_processes_ready(local_ready: bool) -> bool:
     return bool(np.all(flags))
 
 
+def global_max_int(value: int) -> int:
+    """MAX-reduce a host-side integer across processes. Collective —
+    every process must call it at the same loop point. Used by the
+    multi-host fused replay to agree on a uniform flush-round count
+    before the lockstep flush dispatches (each host's staged backlog
+    differs; the flush program is a global-array computation every
+    process must enter the same number of times). Single-process:
+    identity."""
+    if not is_multiprocess():
+        return int(value)
+    from jax.experimental import multihost_utils
+    vals = multihost_utils.process_allgather(np.asarray([int(value)]))
+    return int(np.max(vals))
+
+
 def local_rows(arr: jax.Array) -> np.ndarray:
     """This process's rows of a batch-axis-sharded result, in shard order
     (e.g. per-sample |TD| destined for the local replay shard's PER

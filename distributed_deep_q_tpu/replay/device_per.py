@@ -523,6 +523,13 @@ class DevicePERFrameReplay(DeviceFrameReplay):
         self._stage_columns += [
             ((), np.int32), ((), np.float32), ((), np.uint8), ((), np.uint8)]
         self._di_cache: tuple[np.ndarray, np.ndarray] | None = None
+        self._pending_seals: list[tuple[int, int]] = []
+        # to_global assembles a contiguous local block per process
+        assert self.local_shards == list(range(
+            self.local_shards[0], self.local_shards[0]
+            + len(self.local_shards))), (
+            "mesh device order must group each process's shards "
+            "contiguously for P('dp') local-block assembly")
 
         sharded = NamedSharding(mesh, P(AXIS_DP))
         replicated = NamedSharding(mesh, P())
@@ -648,12 +655,15 @@ class DevicePERFrameReplay(DeviceFrameReplay):
         self._di_cache = None  # cursors/sizes moved
 
     def _apply_write(self, idx, cols) -> None:
-        """Route each padded chunk to the fused write: metadata scatters
-        (real coords, fresh-row priorities seeded from the device max) +
-        the frame-row DMA plane (padded coords, ghost duplicates, padding
-        lanes → the scratch row)."""
-        d, k = self.num_shards, self.write_chunk
-        i2 = idx.reshape(d, k)
+        """Route each padded chunk ([local_shards, k] planes) to the fused
+        write: metadata scatters (real coords, fresh-row priorities seeded
+        from the device max) + the frame-row DMA plane (padded coords,
+        ghost duplicates, padding lanes → the scratch row). Multi-host:
+        every plane assembles this process's local rows into the global
+        P('dp') arrays; every process enters this program in lockstep
+        (``flush``'s agreed round count)."""
+        k = self.write_chunk
+        i2 = idx  # [dl, k], in-shard real coords
         ok = i2 < self.cap_local
         sub = np.where(ok, i2 // self.slot_cap, 0)
         local = np.where(ok, i2 % self.slot_cap, 0)
@@ -662,13 +672,49 @@ class DevicePERFrameReplay(DeviceFrameReplay):
         ghost = np.where(ok & (local < self.window - 1),
                          sub * self.slot_pad + self.slot_cap + local,
                          scratch)
-        src = np.tile(np.arange(k, dtype=np.int32), (d, 1))
-        sidx = np.concatenate([src, src], axis=1).reshape(-1)
-        didx = np.concatenate([main, ghost], axis=1).astype(
-            np.int32).reshape(-1)
-        staged = cols[0].reshape(-1).view(np.int32)  # packed pixel bytes
+        dl = i2.shape[0]
+        src = np.tile(np.arange(k, dtype=np.int32), (dl, 1))
+        sidx = np.concatenate([src, src], axis=1)
+        didx = np.concatenate([main, ghost], axis=1).astype(np.int32)
+        staged = np.ascontiguousarray(cols[0]).reshape(dl, -1).view(
+            np.int32)
         self.dstate = self._write_full(
-            self.dstate, idx, *cols[1:], sidx, didx, staged)
+            self.dstate,
+            self.to_global(idx.reshape(-1)),
+            *(self.to_global(c.reshape((dl * k,) + t))
+              for c, (t, _) in zip(cols[1:], self._stage_columns[1:])),
+            self.to_global(sidx.reshape(-1)),
+            self.to_global(didx.reshape(-1)),
+            self.to_global(staged.reshape(-1)))
+
+    # -- multi-host plumbing -------------------------------------------------
+
+    def to_global(self, local: np.ndarray):
+        """Assemble a per-process local plane (this process's contiguous
+        block of a ``P('dp')``-sharded array, dim 0) into the global jax
+        array; identity on a single process."""
+        if self._pc == 1:
+            return local
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
+
+        spec = P(*((AXIS_DP,) + (None,) * (local.ndim - 1)))
+        factor = self.num_shards // len(self.local_shards)
+        gshape = (local.shape[0] * factor,) + local.shape[1:]
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, spec), np.ascontiguousarray(local),
+            global_shape=gshape)
+
+    def to_replicated(self, arr: np.ndarray):
+        """Replicate a host value onto the (possibly multi-host) mesh."""
+        if self._pc == 1:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P()), np.ascontiguousarray(arr),
+            global_shape=arr.shape)
 
     def sample(self, batch_size: int):
         raise TypeError(
@@ -683,12 +729,20 @@ class DevicePERFrameReplay(DeviceFrameReplay):
     def reset_stream(self, stream: int) -> None:
         """Seal the stream's current slot on HOST AND DEVICE: the fused
         sampler reads the device boundary ring, so a host-only seal would
-        let sampled windows straddle the dead writer's seam."""
+        let sampled windows straddle the dead writer's seam.
+
+        Multi-host the device seal DEFERS to the next lockstep flush (the
+        seal program runs on global arrays — a per-process immediate
+        dispatch would deadlock the collective); the sealed row's
+        position is fixed at request time, so later ingest into the same
+        slot (which appends past it) cannot invalidate it within a
+        chunk."""
         if not (0 <= stream < self.num_streams):
             return
-        # flush FIRST: rows still staged carry their pre-seal boundary
-        # values and a later flush would scatter them over the seal
-        self.flush()
+        if self._pc == 1:
+            # flush FIRST: rows still staged carry their pre-seal boundary
+            # values and a later flush would scatter them over the seal
+            self.flush()
         cycle = self._slot_cycle[stream]
         slot = cycle[self._stream_pos[stream] % len(cycle)]
         m = self.slots[slot]
@@ -697,11 +751,41 @@ class DevicePERFrameReplay(DeviceFrameReplay):
             return
         local = (m._cursor - 1) % self.slot_cap
         shard, base_off = self._slot_base(slot)
+        if self._pc > 1:
+            self._pending_seals.append((shard, base_off + local))
+            return
         # one lane per shard; non-owners carry an OOB index the scatter drops
         idx = np.full(self.num_shards, self.cap_local, np.int32)
         idx[shard] = base_off + local
         self.dstate = self.dstate.replace(
             boundary=self._seal_writer(self.dstate.boundary, idx))
+
+    def flush(self) -> None:
+        """Base flush (agreed round count multi-host) + deferred device
+        seals (one lockstep seal program per agreed seal round). Seals
+        drain AFTER the staged rows so pre-seal rows cannot scatter over
+        the seal — the single-process ordering, preserved."""
+        super().flush()
+        if self._pc == 1:
+            return
+        from distributed_deep_q_tpu.parallel.multihost import global_max_int
+
+        per_shard: dict[int, list[int]] = {}
+        for shard, row in self._pending_seals:
+            per_shard.setdefault(shard, []).append(row)
+        self._pending_seals.clear()
+        rounds = global_max_int(max((len(v) for v in per_shard.values()),
+                                    default=0))
+        dl = len(self.local_shards)
+        for r in range(rounds):
+            idx = np.full(dl, self.cap_local, np.int32)
+            for li, s in enumerate(self.local_shards):
+                rows = per_shard.get(s, [])
+                if r < len(rows):
+                    idx[li] = rows[r]
+            self.dstate = self.dstate.replace(
+                boundary=self._seal_writer(self.dstate.boundary,
+                                           self.to_global(idx)))
 
     # -- learner-side inputs -------------------------------------------------
     # (β comes from the inherited ``beta`` property; the fused path never
@@ -718,8 +802,10 @@ class DevicePERFrameReplay(DeviceFrameReplay):
         return out
 
     def device_inputs(self):
-        """(cursors, sizes) int32 host arrays, shard-major ``[D·subs]`` so
-        ``P('dp')`` hands each device its own sub-rings' state.
+        """(cursors, sizes) int32 host arrays for this process's LOCAL
+        shards, shard-major ``[dl·subs]`` — the local block of the global
+        ``P('dp')`` plane (``to_global`` assembles it; single-process the
+        local block IS the plane).
 
         Cached between writes: the idle hot loop (no ingest since the last
         step) pays one ``is None`` check instead of a Python pass over all
@@ -727,12 +813,13 @@ class DevicePERFrameReplay(DeviceFrameReplay):
         host time (VERDICT r3 weak #3)."""
         if self._di_cache is None:
             d, subs = self.num_shards, self.subs_per_shard
-            cursors = np.zeros(d * subs, np.int32)
-            sizes = np.zeros(d * subs, np.int32)
-            for g in range(self.num_slots):
-                s, sub = g % d, g // d
-                m = self.slots[g]
-                cursors[s * subs + sub] = m._cursor
-                sizes[s * subs + sub] = len(m)
+            dl = len(self.local_shards)
+            cursors = np.zeros(dl * subs, np.int32)
+            sizes = np.zeros(dl * subs, np.int32)
+            for li, s in enumerate(self.local_shards):
+                for sub in range(subs):
+                    m = self.slots[sub * d + s]
+                    cursors[li * subs + sub] = m._cursor
+                    sizes[li * subs + sub] = len(m)
             self._di_cache = (cursors, sizes)
         return self._di_cache

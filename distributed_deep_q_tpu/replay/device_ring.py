@@ -99,7 +99,18 @@ class DeviceFrameReplay:
         self.mesh = mesh
         d = self.num_shards = mesh.shape[AXIS_DP]
         self.num_streams = max(int(num_streams), 1)
-        self.subs_per_shard = -(-max(self.num_streams, d) // d)  # ceil
+        # multi-controller topology (SURVEY §7.3 item 6): this process
+        # owns only the shards whose devices it hosts; its streams route
+        # to slots on those shards, its staging covers only them, and
+        # flush planes assemble per-process local rows into the global
+        # sharded arrays. Geometry (subs/slot_cap) must be identical on
+        # every process, so it derives from the GLOBAL stream count.
+        self._pc = jax.process_count()
+        self._pid = jax.process_index()
+        self.local_shards = [s for s, dev in enumerate(mesh.devices.flat)
+                             if dev.process_index == self._pid]
+        total_streams = self.num_streams * self._pc
+        self.subs_per_shard = -(-max(total_streams, d) // d)  # ceil
         g = self.num_slots = self.subs_per_shard * d
         self.slot_cap = int(cfg.capacity) // g
         assert self.slot_cap > 0 and cfg.batch_size % d == 0, (
@@ -130,10 +141,20 @@ class DeviceFrameReplay:
         self.max_priority = 1.0
         self._samples = 0
 
-        # stream → its slot cycle (stream i owns slots {g : g % streams == i})
-        self._slot_cycle = [[s for s in range(g) if s % self.num_streams == i]
-                            for i in range(self.num_streams)]
+        # stream → its slot cycle over this process's LOCAL slots (stream
+        # i owns every num_streams-th local slot; single-process this is
+        # exactly the old {g : g % num_streams == i} assignment since
+        # local slots are all slots in order)
+        local_set = set(self.local_shards)
+        local_slots = [s for s in range(g) if s % d in local_set]
+        self._slot_cycle = [
+            [s for j, s in enumerate(local_slots) if j % self.num_streams == i]
+            for i in range(self.num_streams)]
         self._stream_pos = [0] * self.num_streams
+        # multi-host: flushes must be LOCKSTEP collectives (the scatter
+        # runs on global arrays), so ingest defers them to the chunk
+        # boundary where every process flushes an agreed round count
+        self.defer_flush = self._pc > 1
 
         self._row_len = int(np.prod(self.frame_shape))
         self._alloc_ring()
@@ -217,10 +238,13 @@ class DeviceFrameReplay:
         draws batch/D from *each* shard — SURVEY §7.3 item 6)."""
         if len(self) < learn_start:
             return False
-        per_shard = [0] * self.num_shards
+        # multi-host: a process can only see (and fill) its local shards;
+        # the cross-host AND happens at the caller (all_processes_ready)
+        per_shard = {s: 0 for s in self.local_shards}
         for g in range(self.num_slots):
-            per_shard[g % self.num_shards] += self._sampleable(g)
-        return all(mass > 0 for mass in per_shard)
+            if g % self.num_shards in per_shard:
+                per_shard[g % self.num_shards] += self._sampleable(g)
+        return all(mass > 0 for mass in per_shard.values())
 
     @property
     def beta(self) -> float:
@@ -252,7 +276,8 @@ class DeviceFrameReplay:
             # episode finished → move this stream to its next slot, so one
             # stream eventually reaches every shard it owns
             self._stream_pos[0] += 1
-        if max(self._pending_rows) >= self.write_chunk:
+        if max(self._pending_rows) >= self.write_chunk \
+                and not self.defer_flush:
             self.flush()
         return int(self._global_index(slot, np.asarray(i)))
 
@@ -293,7 +318,8 @@ class DeviceFrameReplay:
             if boundary[s1 - 1]:
                 self._stream_pos[stream] += 1
             s0 = s1
-        if max(self._pending_rows) >= self.write_chunk:
+        if max(self._pending_rows) >= self.write_chunk \
+                and not self.defer_flush:
             self.flush()
         return out
 
@@ -309,27 +335,44 @@ class DeviceFrameReplay:
         slot = cycle[self._stream_pos[stream] % len(cycle)]
         self.slots[slot].seal_stream()
 
+    def _flush_rounds_needed(self) -> int:
+        return -(-max((self._pending_rows[s] for s in self.local_shards),
+                      default=0) // self.write_chunk)
+
     def flush(self) -> None:
         """Push all staged frames to HBM in fixed-shape chunks.
 
-        Every flush writes ``write_chunk`` lanes per shard (one compiled
-        program); shards with fewer pending frames pad with out-of-bounds
-        indices that the scatter drops.
+        Every flush writes ``write_chunk`` lanes per LOCAL shard (one
+        compiled program); shards with fewer pending frames pad with
+        out-of-bounds indices that the scatter drops. Multi-host: the
+        scatter is a global-array computation — a collective every
+        process must enter the same number of times — so the round count
+        is MAX-agreed across processes first (``global_max_int``) and
+        short hosts dispatch all-padding chunks. Every process must
+        therefore call ``flush()`` at the same loop point (the fused
+        chunk boundary does; ingest defers via ``defer_flush``).
         """
-        while any(self._pending_rows):
-            k, d = self.write_chunk, self.num_shards
-            idx = np.full((d, k), self.cap_local, np.int32)  # OOB = dropped
-            cols = [np.zeros((d, k) + tail, dt)
+        rounds = self._flush_rounds_needed()
+        if self._pc > 1:
+            from distributed_deep_q_tpu.parallel.multihost import (
+                global_max_int)
+            rounds = global_max_int(rounds)
+        k = self.write_chunk
+        shards = self.local_shards
+        for _ in range(rounds):
+            dl = len(shards)
+            idx = np.full((dl, k), self.cap_local, np.int32)  # OOB = drop
+            cols = [np.zeros((dl, k) + tail, dt)
                     for tail, dt in self._stage_columns]
-            for s in range(d):
+            for li, s in enumerate(shards):
                 fill = 0
                 while self._pending[s] and fill < k:
                     entry = self._pending[s][0]
                     i_arr = entry[0]
                     take = min(len(i_arr), k - fill)
-                    idx[s, fill:fill + take] = i_arr[:take]
+                    idx[li, fill:fill + take] = i_arr[:take]
                     for col, arr in zip(cols, entry[1:]):
-                        col[s, fill:fill + take] = arr[:take]
+                        col[li, fill:fill + take] = arr[:take]
                     fill += take
                     self._pending_rows[s] -= take
                     if take == len(i_arr):
@@ -337,16 +380,20 @@ class DeviceFrameReplay:
                     else:  # split the chunk, preserving FIFO write order
                         self._pending[s][0] = tuple(
                             a[take:] for a in entry)
-            self._apply_write(
-                idx.reshape(d * k),
-                [c.reshape((d * k,) + t) for c, (t, _) in
-                 zip(cols, self._stage_columns)])
+            self._apply_write(idx, cols)
 
     def _apply_write(self, idx: np.ndarray, cols: list) -> None:
-        """Dispatch one padded write chunk to the device ring. Subclasses
-        with extra staged columns (device_per) override this to feed their
-        wider scatter program."""
-        self.ring = self._write(self.ring, idx, cols[0])
+        """Dispatch one padded write chunk ([local_shards, k] planes) to
+        the device ring. Subclasses with extra staged columns (device_per)
+        override this to feed their wider scatter program."""
+        d, k = self.num_shards, self.write_chunk
+        assert len(self.local_shards) == d, (
+            "DeviceFrameReplay's host-sample write path is "
+            "single-controller; multi-host pixel runs use the fused "
+            "DevicePERFrameReplay")
+        self.ring = self._write(
+            self.ring, idx.reshape(d * k),
+            cols[0].reshape((d * k,) + self._stage_columns[0][0]))
 
     # -- sample path --------------------------------------------------------
 

@@ -171,9 +171,12 @@ class Solver:
         of per-step host overhead. Returns metrics stacked ``[chain]``
         (device arrays — convert only when logging)."""
         chain = chain or max(int(self.config.replay.fused_chain), 1)
-        if replay.pending_rows():
-            replay.flush()  # device rows must cover everything the host
-            # bookkeeping (cursors/sizes below) claims is written
+        if replay.pending_rows() or replay.defer_flush:
+            # device rows must cover everything the host bookkeeping
+            # (cursors/sizes below) claims is written. Multi-host the
+            # flush is a lockstep collective with an agreed round count,
+            # so EVERY process calls it here even with an empty backlog.
+            replay.flush()
         cursors, sizes = replay.device_inputs()
         betas = replay.next_betas(chain)
         spec = self._dp_spec
@@ -187,6 +190,15 @@ class Solver:
                     replay.num_shards, replay._interpret)
             self._dp_spec, self._dp_spec_replay = spec, replay
         keys = self._next_sample_keys(replay.num_shards, chain)
+        if replay._pc > 1:
+            # multi-controller: ship each plane as this process's local
+            # block of the global P('dp') array (keys are computed
+            # identically everywhere — slice the local shard rows)
+            keys = replay.to_global(
+                np.ascontiguousarray(keys[replay.local_shards]))
+            cursors = replay.to_global(np.asarray(cursors))
+            sizes = replay.to_global(np.asarray(sizes))
+            betas = replay.to_replicated(np.asarray(betas, np.float32))
         self.state, prio, maxp, metrics = \
             self.learner.train_steps_device_per(
                 self.state, replay.dstate, cursors, sizes, betas, keys,

@@ -496,4 +496,5 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
     summary["final_return_avg100"] = ep_returns.value
     summary["eval_return"] = evaluate_recurrent(solver, cfg)
     summary["solver"] = solver
+    summary["replay"] = replay
     return summary

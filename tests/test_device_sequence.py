@@ -183,7 +183,16 @@ def test_recurrent_fused_chained_end_to_end():
                             eval_episodes=1)
     summary = train_recurrent(cfg, log_every=10)
     assert np.isfinite(summary["loss"])
-    assert summary["solver"].step >= 10
+    # exact step total: the FusedStepStream tail clamp must neither skip
+    # nor overrun (learn starts once ready; every 16th env step trains)
+    assert 10 <= summary["solver"].step <= 500 // 16 + 1
+    replay = summary["replay"]
+    prio = np.asarray(replay.dmeta["prio"])
+    seeded = prio[prio > 0]
+    assert len(seeded) > 0, "no sequence priorities were seeded"
+    assert (~np.isclose(seeded, float(np.asarray(replay.dmaxp))
+                        ** replay.alpha)).any(), (
+        "fused sequence steps never moved a priority off the fresh seed")
 
 
 @pytest.mark.slow

@@ -81,26 +81,36 @@ def test_fused_adam_matches_optax_chain():
     }
     ref_opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm),
                           optax.adam(cfg.lr, eps=cfg.adam_eps))
-    my_state = optax.adam(cfg.lr, eps=cfg.adam_eps).init(params)
-    # clip state is EmptyState: adam().init's structure matches position 0
-    ref_state = ref_opt.init(params)
-    my_params = ref_params = params
-    for i in range(4):
-        grads = jax.tree.map(
-            lambda p: jnp.asarray(rng.standard_normal(p.shape) * (10 if
-                                  i == 1 else 0.1), jnp.float32), params)
-        upd, ref_state = ref_opt.update(grads, ref_state, ref_params)
-        ref_params = optax.apply_updates(ref_params, upd)
-        gnorm = optax.global_norm(grads)
-        my_state, my_params = fused_adam_step(cfg, grads, my_state,
-                                              my_params, gnorm)
-    for a, b in zip(jax.tree.leaves(my_params), jax.tree.leaves(ref_params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-6,
-                                   atol=1e-7)
-    # moments too (position 0 of the state tuple holds ScaleByAdamState in
-    # both; ref_state position 1 is the clip's EmptyState vs adam's tail —
-    # values are what matter)
-    for a, b in zip(jax.tree.leaves(my_state[0].mu),
-                    jax.tree.leaves(ref_state[1][0].mu)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-6,
-                                   atol=1e-7)
+    # fused_adam_step must accept BOTH state structures: the chained one
+    # make_optimizer builds when clipping is on (checkpoint-compatible
+    # with pre-fused versions) and the bare adam one (clip off)
+    for my_state in (ref_opt.init(params),
+                     optax.adam(cfg.lr, eps=cfg.adam_eps).init(params)):
+        chained = not isinstance(my_state[0], optax.ScaleByAdamState)
+        ref_state = ref_opt.init(params)
+        my_params = ref_params = params
+        rng = np.random.default_rng(1)
+        for i in range(4):
+            grads = jax.tree.map(
+                lambda p: jnp.asarray(rng.standard_normal(p.shape) * (10 if
+                                      i == 1 else 0.1), jnp.float32),
+                params)
+            upd, ref_state = ref_opt.update(grads, ref_state, ref_params)
+            ref_params = optax.apply_updates(ref_params, upd)
+            gnorm = optax.global_norm(grads)
+            my_state, my_params = fused_adam_step(cfg, grads, my_state,
+                                                  my_params, gnorm)
+        for a, b in zip(jax.tree.leaves(my_params),
+                        jax.tree.leaves(ref_params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-6, atol=1e-7)
+        mine = my_state[1][0] if chained else my_state[0]
+        # structure preserved exactly (checkpoints interchangeable)
+        assert jax.tree_util.tree_structure(my_state) == \
+            jax.tree_util.tree_structure(
+                ref_state if chained
+                else optax.adam(cfg.lr, eps=cfg.adam_eps).init(params))
+        for a, b in zip(jax.tree.leaves(mine.mu),
+                        jax.tree.leaves(ref_state[1][0].mu)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-6, atol=1e-7)
