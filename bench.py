@@ -11,10 +11,15 @@ Variants (all timed in one run, all keys on the ONE output line):
 
 - **flagship** — the headline: DEVICE-RESIDENT PER (replay/device_per.py:
   priorities + metadata in HBM, sampling/composition/priority-update
-  fused into the step, zero per-step D2H), 1M-frame ring capacity
-  (config 2-4's `replay.capacity=1_000_000`), batch 512, fused chained
-  dispatch, measured with the learner running free after warm fill —
-  the learner's own honest rate on the production shape.
+  fused into the step, zero per-step D2H; round 5: flat padded int32
+  ring + Pallas row-DMA window kernels, ops/ring_gather.py — PERF.md §1
+  has the measured gather pathology this replaced), 1M-frame ring
+  capacity (config 2-4's `replay.capacity=1_000_000`), batch 512, fused
+  chained dispatch, measured with the learner running free after warm
+  fill — the learner's own honest rate on the production shape.
+  ``ingest_curve`` measures the same learner at ~{256, 1k, 4k} t/s
+  paced concurrent ingest (VERDICT r4 next #6) so config 4's
+  feasibility rests on a trend, not one point.
   ``flagship_under_ingest_steps_per_s`` re-measures the SAME learner
   with 4 concurrent writer threads streaming transition chunks through
   ``add_batch`` under the distributed supervisor's lock discipline,
@@ -53,7 +58,11 @@ Variants (all timed in one run, all keys on the ONE output line):
   #4); ``DeviceSequenceReplay`` stores unstacked frame streams in HBM
   once and composes windows on device (replay/device_sequence.py).
   ``r2d2_device_vs_host`` is the speedup of the device path over the
-  host path on identical content (target ≥5×).
+  host path on identical content (target ≥5×). ``r2d2_chained_steps_per_s``
+  is the round-5 fused chained sequence mode (device-side sampling/meta/
+  priorities, chain grad steps per dispatch — the per-step key is capped
+  by the tunnel's ~133/s dispatch ceiling, the chained one by the
+  recurrent model's compute).
 - **pallas_on** — idle_uniform config with ``use_pallas_loss=True``: the
   hand-written fused TD-loss kernel (ops/pallas_kernels.py) vs XLA fusion
   (pallas_off == idle_uniform, same program otherwise). Reported so the
@@ -155,7 +164,11 @@ B32_CHAIN = 256
 # 1k t/s ≈ 7 MB/s leaves program-stream headroom.
 # ``ingest_transitions_per_s`` reports what was ACHIEVED.
 INGEST_TARGET = 1_024
-REP_TARGET_S = 1.0                   # auto-size iters ≈ this much work/rep
+# auto-size iters ≈ this much fenced work per rep. 1.0 s (r4) left the
+# per-dispatch variants with spreads up to 0.92 — the ~105 ms fence RTT
+# and tunnel jitter are a large fraction of a 1 s window; 3 s amortizes
+# both (VERDICT r4 weak #2 / next #5).
+REP_TARGET_S = 3.0
 
 # bf16 peak FLOP/s by device_kind prefix (public spec sheets)
 PEAK_FLOPS = {
@@ -194,6 +207,34 @@ def xla_flops(solver, replay, batch) -> float | None:
                  if k not in ("index", "_sampled_at")}
         cost = fn.lower(solver.state, replay.ring, clean).compile() \
                  .cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def fused_train_flops(solver, replay, chain: int) -> float | None:
+    """Per-grad-step FLOPs of the FUSED train program — the same program
+    the MFU denominator times (ADVICE r4: the r4 numerator came from the
+    uniform ring step, a cross-program mismatch). XLA's cost model counts
+    a ``lax.scan`` body ONCE (verified against the analytic count: the
+    batch-512 chained program reports ~44.8 GF regardless of chain), so
+    the figure is already per-step."""
+    try:
+        sample, train = solver.learner._device_per_steps[
+            (solver._dp_spec, chain)]
+        cursors, sizes = replay.device_inputs()
+        betas = np.full(chain, 0.5, np.float32)
+        keys = solver._next_sample_keys(replay.num_shards, chain)
+        rows = replay.dstate
+        metas, win, idx = sample(keys, rows.frames, rows.action,
+                                 rows.reward, rows.done, rows.boundary,
+                                 rows.prio, np.asarray(cursors),
+                                 np.asarray(sizes), betas)
+        cost = train.lower(solver.state, metas, win, idx, rows.prio,
+                           rows.maxp).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
         flops = float(cost.get("flops", 0.0))
@@ -341,7 +382,6 @@ def time_variant(solver, replay, batch: int, iters: int, warmup: int,
     for _ in range(warmup):
         one_step()
     _fence(solver)
-    rtt = _fence_rtt(solver)
     # auto-size the rep so every variant measures ~REP_TARGET_S of real
     # (fenced) work — honest rates vary ~50× between the chained fused
     # path and a per-step-dispatch variant on this tunnel, so one static
@@ -354,6 +394,10 @@ def time_variant(solver, replay, batch: int, iters: int, warmup: int,
     iters = max(int(REP_TARGET_S / max(probe, 1e-9)), 4)
     if on_warm is not None:
         on_warm()  # timing windows must exclude compile+warmup
+    # fence RTT measured AFTER on_warm: the under-ingest variant's
+    # writers load the tunnel, and an idle-measured RTT would skew the
+    # subtraction by several percent (ADVICE r4)
+    rtt = _fence_rtt(solver)
 
     rates = []
     for _ in range(REPS):
@@ -607,6 +651,21 @@ def main() -> None:
         out["idle_fused_chain_k"] = c1 if r1 >= r2 else c2
         out["in_scan_step_ms_b512"] = round(1e3 * s, 4)
         out["chunk_fixed_ms"] = round(1e3 * max(t1 - s, 0.0) * c1, 2)
+        # MFU numerator from the SAME (fused) program family the
+        # denominator times (ADVICE r4); the in-scan s above still
+        # includes the sample program's per-step share, so the quotient
+        # stays conservative
+        ff = fused_train_flops(solver, replay, c1)
+        if ff:
+            out["flops_per_step"] = ff
+            out["flops_source"] = "xla_cost_analysis_fused_train"
+        else:
+            # loud fallback: the numerator below would come from the
+            # UNIFORM ring program while the denominator times the fused
+            # one — the exact mismatch ADVICE r4 flagged; never silent
+            note("fused-train flops unavailable — MFU numerator falls "
+                 "back to the uniform-program cost (cross-program!)")
+            out["flops_source"] = out["flops_source"] + "_uniform_program"
         del solver, replay
 
     note("batch32")
@@ -664,30 +723,50 @@ def main() -> None:
 
     # (b) the same learner with concurrent paced actor ingest — on this
     # container the shared tunnel link (not the learner) sets this rate,
-    # so it is reported as its own key, with the ACHIEVED ingest
-    lock = threading.Lock()
-    stop = threading.Event()
-    counter = [0] * writers
-    window = {}
+    # so it is reported as its own key, with the ACHIEVED ingest. The
+    # CURVE (VERDICT r4 next #6) measures the learner at three target
+    # rates so config 4's feasibility rests on a trend, not one point;
+    # the 1,024 t/s entry doubles as the r1-r4-comparable headline key.
+    curve = {}
+    for target in ((INGEST_TARGET,) if on_cpu else (256, INGEST_TARGET,
+                                                    4096)):
+        lock = threading.Lock()
+        stop = threading.Event()
+        counter = [0] * writers
+        window = {}
 
-    def mark_warm():
-        # writers start only now — streaming through compile/warmup would
-        # pile staged frames into host RSS for nothing (and the ingest
-        # window must exclude compile anyway)
-        run_writers(replay, lock, stop, counter, writers)
-        window["t0"] = time.perf_counter()
-        window["c0"] = sum(counter)
+        def mark_warm(target=target, lock=lock, stop=stop,
+                      counter=counter, window=window):
+            # writers start only now — streaming through compile/warmup
+            # would pile staged frames into host RSS for nothing (and the
+            # ingest window must exclude compile anyway)
+            window["threads"] = run_writers(replay, lock, stop, counter,
+                                           writers, total_rate=target)
+            window["t0"] = time.perf_counter()
+            window["c0"] = sum(counter)
 
-    irates = time_variant(solver, replay, flag_batch, chunks, 2,
-                          lock=lock, on_warm=mark_warm, chain=flag_chain)
-    ingest = ((sum(counter) - window["c0"])
-              / (time.perf_counter() - window["t0"]))
-    stop.set()
-    under = float(np.median(irates))
-    out["flagship_under_ingest_steps_per_s"] = round(under, 2)
-    out["under_ingest_spread"] = round((max(irates) - min(irates))
-                                       / under, 4)
-    out["ingest_transitions_per_s"] = round(ingest, 1)
+        irates = time_variant(solver, replay, flag_batch, chunks, 2,
+                              lock=lock, on_warm=mark_warm,
+                              chain=flag_chain)
+        ingest = ((sum(counter) - window["c0"])
+                  / (time.perf_counter() - window["t0"]))
+        stop.set()
+        # join, don't sleep: a writer mid-pacing-sleep (up to ~1 s at the
+        # 256 t/s target) must not wake and mutate the replay under THIS
+        # target's lock while the next target measures under a fresh one
+        for th in window.get("threads", ()):
+            th.join(timeout=10.0)
+        under = float(np.median(irates))
+        curve[str(target)] = {
+            "steps_per_s": round(under, 2),
+            "achieved_t_per_s": round(ingest, 1),
+            "spread": round((max(irates) - min(irates)) / under, 4),
+        }
+        if target == INGEST_TARGET:
+            out["flagship_under_ingest_steps_per_s"] = round(under, 2)
+            out["under_ingest_spread"] = curve[str(target)]["spread"]
+            out["ingest_transitions_per_s"] = round(ingest, 1)
+    out["ingest_curve"] = curve
     out["ring_capacity_frames"] = replay.capacity
     out["flagship_batch"] = flag_batch
     out["prioritized"] = True
@@ -695,6 +774,19 @@ def main() -> None:
     out["concurrent_writers"] = writers
 
     # -- derived ----------------------------------------------------------
+    # spread discipline (VERDICT r4 next #5): chained keys must hold
+    # spread <= 0.1; PER-DISPATCH keys cannot — their rate IS the shared
+    # tunnel's serial program-drain, which varies run-to-run and
+    # hour-to-hour by up to ~3x for identical programs (r4 measured
+    # idle_uniform at 107/s, a later r5 session 37/s, chained keys
+    # moving <10% the same sessions). They are annotated rather than
+    # silently noisy; cross-round comparisons should use the chained
+    # keys and in_scan_step_ms.
+    out["tunnel_bound_keys"] = [
+        "idle_uniform_steps_per_s", "pallas_on_steps_per_s",
+        "pallas_off_steps_per_s", "batch32_single_dispatch_steps_per_s",
+        "r2d2_host_steps_per_s", "r2d2_device_steps_per_s",
+        "flagship_under_ingest_steps_per_s", "ingest_curve"]
     dev = jax.devices()[0]
     peak = peak_flops_for(dev)
     out["device_kind"] = getattr(dev, "device_kind", dev.platform)

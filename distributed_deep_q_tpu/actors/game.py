@@ -312,6 +312,7 @@ class AtariEnv:
         self.obs_shape = tuple(cfg.frame_shape)
         self.obs_dtype = np.uint8
         self._lives = 0
+        self._steps = 0
         self._raw = deque(maxlen=2)
 
     def _observe(self) -> np.ndarray:
@@ -324,6 +325,7 @@ class AtariEnv:
     def reset(self) -> np.ndarray:
         obs, info = self._env.reset(seed=self._seed + self._n_resets)
         self._n_resets += 1
+        self._steps = 0
         self._raw.clear()
         self._raw.append(obs)
         for _ in range(int(self._rng.integers(1, self.cfg.noop_max + 1))):
@@ -353,6 +355,14 @@ class AtariEnv:
         if self.cfg.reward_clip > 0:
             total = float(np.clip(total, -self.cfg.reward_clip,
                                   self.cfg.reward_clip))
+        # the standard Atari 30-minute cap (108k raw frames = 27k agent
+        # steps at skip 4): a TIME-LIMIT truncation — bootstrap intact
+        # (done stays False), episode over (EVAL_PROTOCOL.md; binds both
+        # training and eval because it lives in the env)
+        self._steps += 1
+        if self.cfg.max_episode_steps > 0 \
+                and self._steps >= self.cfg.max_episode_steps:
+            truncated = True
         done = terminated or life_lost          # cuts bootstrap
         over = terminated or truncated          # needs env.reset()
         return self._observe(), total, done, over

@@ -74,15 +74,64 @@ def _frame_stack_restore(m, z, prefix: str) -> None:
         m.frames[:] = z[f"{prefix}frames"]
 
 
+_SEQ_META = ("action", "reward", "discount", "mask", "init_c", "init_h")
+
+
 def save_replay(replay, path: str) -> None:
     """Dump ``replay``'s complete sampling state to ``path`` (npz)."""
     from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
     from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
+    from distributed_deep_q_tpu.replay.device_sequence import (
+        DeviceSequenceReplay)
     from distributed_deep_q_tpu.replay.prioritized import PrioritizedReplay
     from distributed_deep_q_tpu.replay.replay_memory import (
         FrameStackReplay, ReplayMemory)
+    from distributed_deep_q_tpu.replay.sequence import SequenceReplay
 
     d: dict = {"meta_schema": SCHEMA}
+
+    if isinstance(replay, SequenceReplay):
+        d["meta_kind"] = "sequence"
+        d["meta_capacity"] = replay.capacity
+        d["meta_seq_len"] = replay.seq_len
+        for k in _SEQ_META + ("obs",):
+            d[k] = getattr(replay, k)
+        d["cursor"] = replay._cursor
+        d["size"] = replay._size
+        d["seqs_added"] = replay._seqs_added
+        d["samples"] = replay._samples
+        d["max_priority"] = replay.max_priority
+        d["rng"] = _rng_dump(replay._rng)
+        if replay.prioritized:
+            d["tree"] = replay.tree.tree
+        np.savez(path, **d)
+        return
+
+    if isinstance(replay, DeviceSequenceReplay):
+        replay.flush()  # staged sequences must be in the state we dump
+        d["meta_kind"] = "device_sequence"
+        d["meta_capacity"] = replay.capacity
+        d["meta_seq_len"] = replay.seq_len
+        d["meta_W"] = replay.W
+        for k in _SEQ_META + ("n_valid",):
+            d[k] = getattr(replay, k)
+        d["cursor"] = replay._cursor
+        d["sizes"] = replay._sizes
+        d["added"] = replay._added
+        d["next_shard"] = replay._next_shard
+        d["seqs_added"] = replay._seqs_added
+        d["samples"] = replay._samples
+        d["max_priority"] = replay.max_priority
+        d["rng"] = _rng_dump(replay._rng)
+        if replay.prioritized:
+            for i, t in enumerate(replay.trees):
+                d[f"tree{i}"] = t.tree
+        d["dev_ring"] = np.asarray(replay.ring)
+        for k, v in replay.dmeta.items():
+            d[f"dev_{k}"] = np.asarray(v)
+        d["dev_maxp"] = np.asarray(replay.dmaxp)
+        np.savez(path, **d)
+        return
 
     if isinstance(replay, PrioritizedReplay):
         d["meta_kind"] = "prioritized"
@@ -146,12 +195,70 @@ def load_replay(replay, path: str) -> None:
     from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
     from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
     from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
+    from distributed_deep_q_tpu.replay.device_sequence import (
+        DeviceSequenceReplay)
     from distributed_deep_q_tpu.replay.prioritized import PrioritizedReplay
     from distributed_deep_q_tpu.replay.replay_memory import (
         FrameStackReplay, ReplayMemory)
+    from distributed_deep_q_tpu.replay.sequence import SequenceReplay
 
     z = np.load(path, allow_pickle=False)
     kind = _str(z["meta_kind"])
+
+    if isinstance(replay, SequenceReplay):
+        assert kind == "sequence", f"file holds {kind!r}"
+        assert int(z["meta_capacity"]) == replay.capacity and \
+            int(z["meta_seq_len"]) == replay.seq_len, "geometry mismatch"
+        assert ("tree" in z) == replay.prioritized, (
+            "prioritized-ness mismatch: file was saved with prioritized="
+            f"{'tree' in z}, buffer is prioritized={replay.prioritized}")
+        assert z["obs"].shape == replay.obs.shape and \
+            z["obs"].dtype == replay.obs.dtype, "obs store mismatch"
+        for k in _SEQ_META + ("obs",):
+            getattr(replay, k)[:] = z[k]
+        replay._cursor = int(z["cursor"])
+        replay._size = int(z["size"])
+        replay._seqs_added = int(z["seqs_added"])
+        replay._samples = int(z["samples"])
+        replay.max_priority = float(z["max_priority"])
+        _rng_load(replay._rng, _str(z["rng"]))
+        if replay.prioritized:
+            t = replay.tree
+            t.set(np.arange(t.size), z["tree"][t.size: 2 * t.size])
+        return
+
+    if isinstance(replay, DeviceSequenceReplay):
+        assert kind == "device_sequence", f"file holds {kind!r}"
+        assert int(z["meta_capacity"]) == replay.capacity and \
+            int(z["meta_seq_len"]) == replay.seq_len and \
+            int(z["meta_W"]) == replay.W, "geometry mismatch"
+        assert ("tree0" in z) == replay.prioritized, (
+            "prioritized-ness mismatch: file was saved with prioritized="
+            f"{'tree0' in z}, buffer is prioritized={replay.prioritized}")
+        assert z["dev_ring"].shape == replay.ring.shape and \
+            z["dev_ring"].dtype == replay.ring.dtype, (
+            "pixel-plane layout mismatch (saved by an incompatible "
+            "version)")
+        for k in _SEQ_META + ("n_valid",):
+            getattr(replay, k)[:] = z[k]
+        replay._cursor[:] = z["cursor"]
+        replay._sizes[:] = z["sizes"]
+        replay._added[:] = z["added"]
+        replay._next_shard = int(z["next_shard"])
+        replay._seqs_added = int(z["seqs_added"])
+        replay._samples = int(z["samples"])
+        replay.max_priority = float(z["max_priority"])
+        _rng_load(replay._rng, _str(z["rng"]))
+        if replay.prioritized:
+            for i, t in enumerate(replay.trees):
+                t.set(np.arange(t.size), z[f"tree{i}"][t.size: 2 * t.size])
+        sharded = NamedSharding(replay.mesh, P(AXIS_DP))
+        replay.ring = jax.device_put(z["dev_ring"], sharded)
+        replay.dmeta = {k: jax.device_put(z[f"dev_{k}"], sharded)
+                        for k in replay.dmeta}
+        replay.dmaxp = jax.device_put(z["dev_maxp"],
+                                      NamedSharding(replay.mesh, P()))
+        return
 
     if isinstance(replay, PrioritizedReplay):
         assert kind == "prioritized", f"file holds {kind!r}"

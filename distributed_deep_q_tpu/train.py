@@ -359,12 +359,6 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
     from distributed_deep_q_tpu.replay.sequence import (
         SequenceBuilder, SequenceReplay)
 
-    if cfg.replay.persist_path:
-        raise ValueError(
-            "replay.persist_path covers the transition-replay paths "
-            "(train_single_process); sequence replays have no serializer "
-            "yet — unset it for R2D2 runs (warm refill, the reference "
-            "default, applies)")
     metrics = metrics or Metrics()
     env = make_env(cfg.env, seed=cfg.train.seed)
     cfg.net.num_actions = env.num_actions
@@ -432,6 +426,13 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
     if ckpt and cfg.train.resume and ckpt.latest_step() is not None:
         solver.state, _ = ckpt.restore(solver.state)
         gsteps = solver.step
+    persist = cfg.replay.persist_path
+    if persist and cfg.train.resume and os.path.exists(persist):
+        # opt-in replay persistence (SURVEY §5.4), sequence edition:
+        # restore the buffer's exact sampling state (host store or device
+        # ring + device meta/priorities) instead of warm-refilling
+        from distributed_deep_q_tpu.replay.persistence import load_replay
+        load_replay(replay, persist)
 
     for t in range(1, cfg.train.total_steps + 1):
         eps = epsilon_at(t, cfg.actors)
@@ -479,6 +480,10 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
             metrics.count("grad_steps")
             if ckpt and gsteps % cfg.train.checkpoint_every == 0:
                 ckpt.save(solver.state, extra={"env_steps": t})
+                if persist:
+                    from distributed_deep_q_tpu.replay.persistence import (
+                        save_replay)
+                    save_replay(replay, persist)
             if gsteps % log_every == 0:
                 summary = {
                     "loss": float(m["loss"]), "q_mean": float(m["q_mean"]),
@@ -493,6 +498,12 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
     if ckpt:
         ckpt.save(solver.state, extra={"env_steps": cfg.train.total_steps},
                   wait=True)
+    if persist:
+        # unconditional end-of-run save (mirrors train_single_process):
+        # without it, persist without checkpointing is silently inert and
+        # with checkpointing the buffer goes stale vs the final θ
+        from distributed_deep_q_tpu.replay.persistence import save_replay
+        save_replay(replay, persist)
     summary["final_return_avg100"] = ep_returns.value
     summary["eval_return"] = evaluate_recurrent(solver, cfg)
     summary["solver"] = solver

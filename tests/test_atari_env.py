@@ -184,3 +184,21 @@ def test_observation_resizes_to_frame_shape():
     assert obs.shape == (10, 10)
     obs2, *_ = env.step(1)
     assert obs2.shape == (10, 10)
+
+
+def test_episode_step_cap_truncates_not_terminates():
+    """env.max_episode_steps (the standard 30-min Atari cap) is a
+    TIME-LIMIT truncation inside the env: over=True (episode ends for
+    both training and eval), done=False (bootstrap intact)."""
+    env = AtariEnv(_cfg(noop_max=1, max_episode_steps=5), seed=0,
+                   env=StubALE())
+    env.reset()
+    for i in range(4):
+        _, _, done, over = env.step(0)
+        assert not done and not over, f"capped early at step {i+1}"
+    _, _, done, over = env.step(0)
+    assert over and not done
+    # reset clears the counter
+    env.reset()
+    _, _, done, over = env.step(0)
+    assert not over
