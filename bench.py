@@ -223,16 +223,20 @@ def fused_train_flops(solver, replay, chain: int) -> float | None:
     batch-512 chained program reports ~44.8 GF regardless of chain), so
     the figure is already per-step."""
     try:
+        import jax
+
         sample, train = solver.learner._device_per_steps[
             (solver._dp_spec, chain)]
         cursors, sizes = replay.device_inputs()
         betas = np.full(chain, 0.5, np.float32)
-        keys = solver._next_sample_keys(replay.num_shards, chain)
+        keys = np.zeros((replay.num_shards, chain, 2), np.uint32)
         rows = replay.dstate
-        metas, win, idx = sample(keys, rows.frames, rows.action,
-                                 rows.reward, rows.done, rows.boundary,
-                                 rows.prio, np.asarray(cursors),
-                                 np.asarray(sizes), betas)
+        # eval_shape: the lowering only needs avals — no device sample
+        # execution, no sampling-key-stream side effect
+        metas, win, idx = jax.eval_shape(
+            sample, keys, rows.frames, rows.action, rows.reward,
+            rows.done, rows.boundary, rows.prio, np.asarray(cursors),
+            np.asarray(sizes), betas)
         cost = train.lower(solver.state, metas, win, idx, rows.prio,
                            rows.maxp).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
@@ -382,19 +386,21 @@ def time_variant(solver, replay, batch: int, iters: int, warmup: int,
     for _ in range(warmup):
         one_step()
     _fence(solver)
+    if on_warm is not None:
+        on_warm()  # timing windows must exclude compile+warmup
     # auto-size the rep so every variant measures ~REP_TARGET_S of real
     # (fenced) work — honest rates vary ~50× between the chained fused
     # path and a per-step-dispatch variant on this tunnel, so one static
-    # iters either wastes minutes or measures noise
+    # iters either wastes minutes or measures noise. Sized AFTER on_warm
+    # so the under-ingest variants probe the LOADED rate (an idle-sized
+    # rep runs ~25-55× long once writers drop the learner to ~11-22/s).
     t0 = time.perf_counter()
     for _ in range(max(iters // 16, 2)):
         one_step()
     _fence(solver)
     probe = (time.perf_counter() - t0) / max(iters // 16, 2)
     iters = max(int(REP_TARGET_S / max(probe, 1e-9)), 4)
-    if on_warm is not None:
-        on_warm()  # timing windows must exclude compile+warmup
-    # fence RTT measured AFTER on_warm: the under-ingest variant's
+    # fence RTT measured AFTER on_warm too: the under-ingest variant's
     # writers load the tunnel, and an idle-measured RTT would skew the
     # subtraction by several percent (ADVICE r4)
     rtt = _fence_rtt(solver)

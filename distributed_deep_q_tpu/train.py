@@ -427,6 +427,11 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
         solver.state, _ = ckpt.restore(solver.state)
         gsteps = solver.step
     persist = cfg.replay.persist_path
+    if persist and jax.process_count() > 1:
+        # per-process shard files (same rule as train_single_process): a
+        # shared path would race on save and clone one process's state
+        # onto every host on resume
+        persist = f"{persist}.proc{jax.process_index()}"
     if persist and cfg.train.resume and os.path.exists(persist):
         # opt-in replay persistence (SURVEY §5.4), sequence edition:
         # restore the buffer's exact sampling state (host store or device
