@@ -216,6 +216,16 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     local θ copy.
     """
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # The env var alone is NOT enough on hosts whose sitecustomize
+    # pre-imports jax with an accelerator platform pinned: jax latches
+    # the env into its config default AT IMPORT, so a spawned actor that
+    # only sets the env still initializes the accelerator client on its
+    # first op (measured: recurrent actors hung on the remote compile
+    # service, never delivering a transition). Overriding the config
+    # works until the backend is first used — which is exactly now.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
     # late imports: after the platform pin, inside the child process
     from distributed_deep_q_tpu.actors.game import (
         FrameStacker, NStepAccumulator, make_env)
@@ -767,15 +777,18 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
         all_processes_ready, local_rows)
     # config 5 full shape, recurrent edition: per-host server + actor
     # slice + sequence-replay shard
-    # fused_ok=False: DeviceSequenceReplay has no multi-host staging yet —
-    # reject loudly instead of silently falling back to the host store
     cfg, local_batch, metrics, pc, pid = _split_fleet_across_processes(
-        cfg, pixel, metrics, "device sequence ring")
+        cfg, pixel, metrics, "device sequence ring", fused_ok=True)
     seq_len = cfg.replay.sequence_length
     # transition-denominated config fields scale down to sequence units;
     # β anneal runs per sample() = per grad step in this topology
     seq_capacity = max(cfg.replay.capacity // seq_len, 64)
-    device_seq = pixel and cfg.replay.device_resident and pc == 1
+    # device residency: single-controller for the host-sampled per-step
+    # path; multi-controller ONLY through the fused ring (per-host
+    # staging + lockstep flush — the _split gate enforces prioritized +
+    # device_per for pc > 1)
+    device_seq = pixel and cfg.replay.device_resident and (
+        pc == 1 or (cfg.replay.prioritized and cfg.replay.device_per))
     if device_seq:
         # R2D2 pixel plane in HBM (replay/device_sequence.py): actors
         # stream stacked sequences over RPC unchanged; the server derives
@@ -886,4 +899,5 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
     summary["env_steps"] = server.env_steps
     summary["actor_restarts"] = sup.restarts
     summary["solver"] = solver
+    summary["replay"] = replay
     return summary

@@ -317,9 +317,13 @@ class SequenceLearner:
             self._fused_steps[cache_key] = self._build_fused_steps(
                 spec, chain)
         sample, train = self._fused_steps[cache_key]
+
+        def feed(x, dtype=None):
+            # multi-host global arrays pass through untouched
+            return x if isinstance(x, jax.Array) else np.asarray(x, dtype)
+
         metas, win, idx = sample(keys, replay.ring, replay.dmeta,
-                                 np.asarray(sizes),
-                                 np.asarray(betas, np.float32))
+                                 feed(sizes), feed(betas, np.float32))
         return train(state, metas, win, idx, replay.dmeta["prio"],
                      replay.dmaxp)
 
@@ -404,11 +408,18 @@ class SequenceSolver:
         from distributed_deep_q_tpu.solver import next_fused_keys
 
         chain = chain or max(int(self.config.replay.fused_chain), 1)
-        if replay.pending_rows():
+        if replay.pending_rows() or replay.defer_flush:
+            # multi-host the flush is a lockstep collective with an
+            # agreed round count — every process calls it here
             replay.flush()
         sizes = replay.device_inputs()
         betas = replay.next_betas(chain)
         keys = next_fused_keys(self, replay.num_shards, chain)
+        if replay._pc > 1:
+            keys = replay.to_global(
+                np.ascontiguousarray(keys[replay.local_shards]))
+            sizes = replay.to_global(np.asarray(sizes))
+            betas = replay.to_replicated(np.asarray(betas, np.float32))
         self.state, prio, maxp, metrics = self.learner.train_steps_fused(
             self.state, replay, self.config.replay.batch_size, sizes,
             betas, keys)

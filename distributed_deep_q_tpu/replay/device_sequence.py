@@ -150,6 +150,20 @@ class DeviceSequenceReplay:
             "DeviceSequenceReplay is the pixel path: obs_shape = (H, W, S)"
         d = self.num_shards = mesh.shape[AXIS_DP]
         self.mesh = mesh
+        # multi-controller topology (mirrors DevicePERFrameReplay): this
+        # process writes only the shards its devices host; flushes become
+        # lockstep collectives with a MAX-agreed round count, and planes
+        # assemble per-process local blocks into the global arrays
+        self._pc = jax.process_count()
+        self._pid = jax.process_index()
+        self.local_shards = [s for s, dev in enumerate(mesh.devices.flat)
+                             if dev.process_index == self._pid]
+        assert self.local_shards == list(range(
+            self.local_shards[0],
+            self.local_shards[0] + len(self.local_shards))), (
+            "mesh device order must group each process's shards "
+            "contiguously for P('dp') local-block assembly")
+        self.defer_flush = self._pc > 1
         self.seq_len = int(seq_len)
         self.stack = int(obs_shape[-1])
         self.frame_shape = tuple(obs_shape[:2])
@@ -271,10 +285,11 @@ class DeviceSequenceReplay:
         return sum(len(p) for p in self._pending)
 
     def ready(self, learn_start: int) -> bool:
-        """Aggregate fill AND every shard sampleable (sample draws B/D
-        from each shard — the device_ring per-shard gate)."""
+        """Aggregate fill AND every LOCAL shard sampleable (sample draws
+        B/D from each shard; multi-host the cross-process AND happens at
+        the caller via all_processes_ready)."""
         return (len(self) >= max(learn_start, 1)
-                and bool((self._sizes > 0).all()))
+                and bool((self._sizes[self.local_shards] > 0).all()))
 
     @property
     def beta(self) -> float:
@@ -290,8 +305,20 @@ class DeviceSequenceReplay:
         return out
 
     def device_inputs(self) -> np.ndarray:
-        """Per-shard filled-slot counts [D] int32 for the fused sampler."""
-        return self._sizes.astype(np.int32)
+        """This process's LOCAL shards' filled-slot counts [dl] int32 for
+        the fused sampler (the local block of the global P('dp') plane —
+        single-process that IS the whole plane)."""
+        return self._sizes[self.local_shards].astype(np.int32)
+
+    def to_replicated(self, arr: np.ndarray):
+        """Replicate a host value onto the (possibly multi-host) mesh."""
+        if self._pc == 1:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P()), np.ascontiguousarray(arr),
+            global_shape=arr.shape)
 
     def _global_slot(self, shard: int, local: int) -> int:
         return shard * self.caps_local + local
@@ -301,9 +328,10 @@ class DeviceSequenceReplay:
     def add_sequence(self, seq: dict[str, np.ndarray]) -> int:
         """Standard ``SequenceBuilder`` emission dict (stacked obs): the
         stream derivation happens here, server-side — actors and the RPC
-        payload are unchanged."""
-        s = self._next_shard
-        self._next_shard = (s + 1) % self.num_shards
+        payload are unchanged. Writes round-robin across this process's
+        LOCAL shards (all shards, single-process)."""
+        s = self.local_shards[self._next_shard % len(self.local_shards)]
+        self._next_shard += 1
         local = int(self._cursor[s])
         self._cursor[s] = (local + 1) % self.caps_local
         self._sizes[s] = min(int(self._sizes[s]) + 1, self.caps_local)
@@ -331,7 +359,8 @@ class DeviceSequenceReplay:
                                  self.mask[g], self.init_c[g],
                                  self.init_h[g]))
         self._seqs_added += 1
-        if max(len(p) for p in self._pending) >= self.write_chunk:
+        if max(len(p) for p in self._pending) >= self.write_chunk \
+                and not self.defer_flush:
             self.flush()
         return g
 
@@ -342,36 +371,63 @@ class DeviceSequenceReplay:
             self.add_sequence({k: v[j] for k, v in batch.items()})
             for j in range(n)], np.int64)
 
+    def to_global(self, local: np.ndarray):
+        """Assemble this process's contiguous local block (dim 0) of a
+        ``P('dp')`` plane into the global array; identity single-process."""
+        if self._pc == 1:
+            return local
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P(*((AXIS_DP,) + (None,) * (local.ndim - 1)))
+        factor = self.num_shards // len(self.local_shards)
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, spec), np.ascontiguousarray(local),
+            global_shape=(local.shape[0] * factor,) + local.shape[1:])
+
     def flush(self) -> None:
-        """Push staged sequences to HBM, ``write_chunk`` per shard per
-        program: ONE row-DMA per sequence (contiguous W-row block) + the
-        metadata scatters; short shards pad with scratch-slot lanes."""
-        while any(self._pending):
-            k, d, t = self.write_chunk, self.num_shards, self.seq_len
-            idx = np.full((d, k), self.caps_local, np.int32)  # scratch
-            staged = np.zeros((d, k, self.W, self.rowb), np.uint8)
-            act = np.zeros((d, k, t), np.int32)
-            rew = np.zeros((d, k, t), np.float32)
-            disc = np.zeros((d, k, t), np.float32)
-            msk = np.zeros((d, k, t), np.float32)
-            ic = np.zeros((d, k, self.lstm_size), np.float32)
-            ih = np.zeros((d, k, self.lstm_size), np.float32)
-            for s in range(d):
+        """Push staged sequences to HBM, ``write_chunk`` per LOCAL shard
+        per program: ONE row-DMA per sequence (contiguous W-row block) +
+        the metadata scatters; short shards pad with scratch-slot lanes.
+        Multi-host: the round count is MAX-agreed across processes (the
+        write is a global-array collective every process must enter
+        equally; short hosts send all-padding chunks), so every process
+        must call flush() at the same loop point — the fused dispatch
+        path does, and ingest defers via ``defer_flush``."""
+        rounds = -(-max((len(self._pending[s]) for s in self.local_shards),
+                        default=0) // self.write_chunk)
+        if self._pc > 1:
+            from distributed_deep_q_tpu.parallel.multihost import (
+                global_max_int)
+            rounds = global_max_int(rounds)
+        for _ in range(rounds):
+            k, t = self.write_chunk, self.seq_len
+            dl = len(self.local_shards)
+            idx = np.full((dl, k), self.caps_local, np.int32)  # scratch
+            staged = np.zeros((dl, k, self.W, self.rowb), np.uint8)
+            act = np.zeros((dl, k, t), np.int32)
+            rew = np.zeros((dl, k, t), np.float32)
+            disc = np.zeros((dl, k, t), np.float32)
+            msk = np.zeros((dl, k, t), np.float32)
+            ic = np.zeros((dl, k, self.lstm_size), np.float32)
+            ih = np.zeros((dl, k, self.lstm_size), np.float32)
+            for li, s in enumerate(self.local_shards):
                 for c in range(min(k, len(self._pending[s]))):
                     (local, stream, a, r, dc, m, c0, h0) = \
                         self._pending[s].pop(0)
-                    idx[s, c] = local
-                    staged[s, c] = stream
-                    act[s, c], rew[s, c], disc[s, c] = a, r, dc
-                    msk[s, c], ic[s, c], ih[s, c] = m, c0, h0
-            src = np.tile(np.arange(k, dtype=np.int32), (d, 1))
+                    idx[li, c] = local
+                    staged[li, c] = stream
+                    act[li, c], rew[li, c], disc[li, c] = a, r, dc
+                    msk[li, c], ic[li, c], ih[li, c] = m, c0, h0
+            src = np.tile(np.arange(k, dtype=np.int32), (dl, 1))
+            g = self.to_global
             self.ring, self.dmeta = self._write(
                 self.ring, self.dmeta, self.dmaxp,
-                idx.reshape(-1), act.reshape(d * k, t),
-                rew.reshape(d * k, t), disc.reshape(d * k, t),
-                msk.reshape(d * k, t), ic.reshape(d * k, -1),
-                ih.reshape(d * k, -1), src.reshape(-1), idx.reshape(-1),
-                staged.reshape(-1).view(np.int32))
+                g(idx.reshape(-1)), g(act.reshape(dl * k, t)),
+                g(rew.reshape(dl * k, t)), g(disc.reshape(dl * k, t)),
+                g(msk.reshape(dl * k, t)), g(ic.reshape(dl * k, -1)),
+                g(ih.reshape(dl * k, -1)), g(src.reshape(-1)),
+                g(idx.reshape(-1)),
+                g(staged.reshape(dl, -1).view(np.int32).reshape(-1)))
 
     # -- sample (per-step host path) ----------------------------------------
 

@@ -28,10 +28,11 @@ def main() -> None:
     kill_an_actor = pid == 0 and mode == "cartpole"
 
     from distributed_deep_q_tpu.config import (
-        MeshConfig, cartpole_config, pong_config)
+        MeshConfig, cartpole_config, pong_config, r2d2_config)
     from distributed_deep_q_tpu.parallel.multihost import initialize_multihost
 
-    cfg = cartpole_config() if mode == "cartpole" else pong_config()
+    cfg = {"cartpole": cartpole_config, "pixel_fused": pong_config,
+           "r2d2_fused": r2d2_config}[mode]()
     cfg.mesh = MeshConfig(backend="cpu", num_fake_devices=8,
                           coordinator=f"127.0.0.1:{port}",
                           num_processes=nproc, process_id=pid)
@@ -69,6 +70,30 @@ def main() -> None:
         # α=0 (fused-uniform); the test asserts real priority movement
         cfg.train.target_update_period = 10
 
+    if mode == "r2d2_fused":
+        # the recurrent edition of the same shape: per-host recurrent
+        # actors → sequences staged into the global sequence ring,
+        # lockstep flush, fused chained recurrent steps
+        import dataclasses
+
+        cfg.env = dataclasses.replace(
+            cfg.env, id="signal", kind="signal_atari", frame_shape=(36, 36))
+        cfg.net.frame_shape = (36, 36)
+        cfg.net.lstm_size = 8
+        cfg.net.compute_dtype = "float32"
+        cfg.replay = dataclasses.replace(
+            cfg.replay, capacity=4096, batch_size=8, learn_start=192,
+            sequence_length=12, burn_in=2, prioritized=True,
+            device_resident=True, device_per=True, write_chunk=2,
+            fused_chain=4)
+        cfg.train.target_update_period = 10
+        cfg.train.eval_episodes = 1
+        # 1 actor per host: this 1-core box starves 2 learner processes
+        # behind 4 playing actors (the pixel_fused mode keeps 4 — its
+        # feed-forward compiles are far lighter)
+        cfg.actors.num_actors = 2
+        cfg.actors.send_batch = 26
+
     if kill_an_actor:
         import multiprocessing as mp
 
@@ -96,17 +121,19 @@ def main() -> None:
         "grad_steps": int(summary["solver"].step),
         "finite": bool(np.isfinite(summary["loss"])),
     }
-    if mode == "pixel_fused":
+    if mode in ("pixel_fused", "r2d2_fused"):
         # device-state evidence for THIS host's shards: pixels landed in
         # the local block of the global ring, and the fused step's
         # priority scatter moved rows off the fresh-row seed
         replay = summary["replay"]
+        if mode == "pixel_fused":
+            frames_arr, prio_arr = replay.dstate.frames, replay.dstate.prio
+        else:
+            frames_arr, prio_arr = replay.ring, replay.dmeta["prio"]
         ring_local = np.concatenate([
-            np.asarray(s.data)
-            for s in replay.dstate.frames.addressable_shards])
+            np.asarray(s.data) for s in frames_arr.addressable_shards])
         prio_local = np.concatenate([
-            np.asarray(s.data)
-            for s in replay.dstate.prio.addressable_shards])
+            np.asarray(s.data) for s in prio_arr.addressable_shards])
         seeded = prio_local[prio_local > 0]
         out["ring_nonzero"] = bool((ring_local != 0).any())
         out["prio_pos"] = int((prio_local > 0).sum())

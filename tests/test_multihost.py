@@ -278,3 +278,40 @@ def test_distributed_fused_per_two_process():
             f"host {r['pid']}'s ring shard holds no pixels"
         assert r["prio_moved"], \
             f"host {r['pid']}: no priority moved off the fresh-row seed"
+
+
+@pytest.mark.slow
+def test_distributed_recurrent_fused_two_process():
+    """Config-5's recurrent edition on the FUSED sequence ring: two
+    learner processes, per-host recurrent actor slices staging sequences
+    into the global DMA ring (lockstep flush), fused chained recurrent
+    steps whose psum/pmax span hosts, per-sequence priorities on device.
+    """
+    worker = os.path.join(REPO, "tests", "_multihost_distributed_worker.py")
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(pid), "2", str(port), "12",
+             "r2d2_fused"],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    outs = [p.communicate(timeout=900) for p in procs]
+    import json
+    results = []
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, (
+            f"fused recurrent config-5 worker failed rc={p.returncode}\n"
+            f"stdout:{so.decode()[-2000:]}\nstderr:{se.decode()[-2000:]}")
+        results.append(json.loads(so.decode().strip().splitlines()[-1]))
+    for r in results:
+        assert r["finite"], f"non-finite loss on host {r['pid']}"
+        assert r["env_steps"] > 0, \
+            f"host {r['pid']}'s actor slice never fed"
+        assert r["grad_steps"] == 12
+        assert r["ring_nonzero"], \
+            f"host {r['pid']}'s sequence ring shard holds no pixels"
+        assert r["prio_moved"], \
+            f"host {r['pid']}: no sequence priority moved off the seed"
