@@ -425,6 +425,8 @@ def run_writers(replay, lock: threading.Lock, stop: threading.Event,
     unthrottled Python writer measures lock starvation, not the production
     regime). Pacing debt is forgiven — a writer stalled behind the lock or
     a JIT compile re-anchors instead of bursting to catch up."""
+    import jax
+
     rng = np.random.default_rng(7)
     frames = rng.integers(0, 255, (chunk, 84, 84), dtype=np.uint8)
     interval = chunk * num_writers / total_rate
@@ -447,6 +449,24 @@ def run_writers(replay, lock: threading.Lock, stop: threading.Event,
                        "reward": np.ones(chunk, np.float32), "done": done}
             with lock:
                 replay.add_batch(payload, stream=stream)
+                probe = getattr(replay, "dstate", None)
+            if t % 4 == 3 and probe is not None:
+                # bound the IN-FLIGHT flush queue, not just staged rows:
+                # add_batch dispatches its own flushes, so the staged-row
+                # backpressure above never fires while the runtime queues
+                # H2D transfers faster than the link drains them — at
+                # ingest targets beyond the link budget that queue grew
+                # to 130 GB RSS and took the host down (the r5 4096-t/s
+                # curve point; same failure class as the r4 unthrottled-
+                # writer OOM). Waiting on one output byte of the latest
+                # flush caps the writer a few flushes ahead of the
+                # device. The buffer may be donated by a later flush
+                # before the read lands — then it's already drained.
+                buf = probe.boundary  # structural breakage fails loudly
+                try:
+                    jax.device_get(buf[:1])
+                except RuntimeError:
+                    pass  # donated mid-read: already drained
             counter[stream] += chunk
             t += 1
             # schedule the next chunk one interval on, but never in the
