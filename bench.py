@@ -135,6 +135,7 @@ Prints ONE JSON line, e.g.:
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 
@@ -418,18 +419,28 @@ def time_variant(solver, replay, batch: int, iters: int, warmup: int,
 
 def run_writers(replay, lock: threading.Lock, stop: threading.Event,
                 counter: list, num_writers: int, chunk: int = 64,
-                total_rate: float = INGEST_TARGET):
+                total_rate: float = INGEST_TARGET,
+                stats: dict | None = None):
     """Actor-ingest load: each writer streams boundary-bearing transition
     chunks into its own ring stream, token-paced to ``total_rate /
     num_writers`` transitions/s each (actors emit at env rate; an
     unthrottled Python writer measures lock starvation, not the production
     regime). Pacing debt is forgiven — a writer stalled behind the lock or
-    a JIT compile re-anchors instead of bursting to catch up."""
+    a JIT compile re-anchors instead of bursting to catch up.
+
+    ``stats`` (optional dict) receives ``max_pending_rows`` — the peak
+    staged/in-flight flush depth observed across all writers, the queue
+    gauge whose absence let the r5 over-link curve point grow host RSS to
+    130 GB unnoticed."""
     import jax
 
     rng = np.random.default_rng(7)
     frames = rng.integers(0, 255, (chunk, 84, 84), dtype=np.uint8)
     interval = chunk * num_writers / total_rate
+    if stats is None:
+        stats = {}
+    stats.setdefault("max_pending_rows", 0)
+    probe_warned = threading.Event()
 
     def writer(stream: int):
         t = 0
@@ -441,8 +452,13 @@ def run_writers(replay, lock: threading.Lock, stop: threading.Event,
             # backpressure: staged rows the learner hasn't flushed yet are
             # host RSS — bound them instead of growing without limit while
             # the learner compiles or drains a fenced rep
-            while replay.pending_rows() > 32_768 and not stop.is_set():
+            pending = replay.pending_rows()
+            if pending > stats["max_pending_rows"]:
+                # racy max across writers — fine for a high-water gauge
+                stats["max_pending_rows"] = pending
+            while pending > 32_768 and not stop.is_set():
                 time.sleep(0.005)
+                pending = replay.pending_rows()
             done = np.zeros(chunk, bool)
             done[-1] = (t % 10 == 9)  # an episode boundary every ~10 chunks
             payload = {"frame": frames, "action": np.zeros(chunk, np.int32),
@@ -467,6 +483,19 @@ def run_writers(replay, lock: threading.Lock, stop: threading.Event,
                     jax.device_get(buf[:1])
                 except RuntimeError:
                     pass  # donated mid-read: already drained
+                except Exception as e:  # noqa: BLE001
+                    # the probe exists for backpressure, not correctness:
+                    # any other failure (backend teardown mid-curve, a
+                    # non-RuntimeError donation error on another jax
+                    # version) must not kill the writer — a dead writer
+                    # mid-rep reads as "the learner got faster". Warn once
+                    # across all writers, keep streaming.
+                    if not probe_warned.is_set():
+                        probe_warned.set()
+                        logging.getLogger(__name__).warning(
+                            "ingest flush probe failed (%s: %s); writers "
+                            "continue without the in-flight cap",
+                            type(e).__name__, e)
             counter[stream] += chunk
             t += 1
             # schedule the next chunk one interval on, but never in the
@@ -760,14 +789,16 @@ def main() -> None:
         stop = threading.Event()
         counter = [0] * writers
         window = {}
+        wstats: dict = {}
 
         def mark_warm(target=target, lock=lock, stop=stop,
-                      counter=counter, window=window):
+                      counter=counter, window=window, wstats=wstats):
             # writers start only now — streaming through compile/warmup
             # would pile staged frames into host RSS for nothing (and the
             # ingest window must exclude compile anyway)
             window["threads"] = run_writers(replay, lock, stop, counter,
-                                           writers, total_rate=target)
+                                           writers, total_rate=target,
+                                           stats=wstats)
             window["t0"] = time.perf_counter()
             window["c0"] = sum(counter)
 
@@ -787,6 +818,9 @@ def main() -> None:
             "steps_per_s": round(under, 2),
             "achieved_t_per_s": round(ingest, 1),
             "spread": round((max(irates) - min(irates)) / under, 4),
+            # peak staged-row depth: the r5 host-OOM signal, now visible
+            # per curve point instead of discovered via RSS post-mortem
+            "max_in_flight_rows": int(wstats.get("max_pending_rows", 0)),
         }
         if target == INGEST_TARGET:
             out["flagship_under_ingest_steps_per_s"] = round(under, 2)

@@ -24,6 +24,7 @@ for correct Q-values.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Any, Protocol
 
@@ -366,6 +367,39 @@ class AtariEnv:
         done = terminated or life_lost          # cuts bootstrap
         over = terminated or truncated          # needs env.reset()
         return self._observe(), total, done, over
+
+
+class StepLatencyEnv:
+    """Transparent env wrapper timing each ``step()`` call (wall ms).
+
+    The remote actor loops drain the buffer into the ``tm_env_step_ms``
+    telemetry channel on every transition flush, giving the learner-side
+    ``fleet/env_step_ms`` histogram its samples. The buffer is bounded so
+    an actor that stops flushing (server gone, long episode) cannot grow
+    it without limit — old samples fall off, which is the right bias for
+    a latency distribution. Everything else delegates to the wrapped env.
+    """
+
+    def __init__(self, env: Env, maxlen: int = 512):
+        self._env = env
+        self._step_ms: deque = deque(maxlen=maxlen)
+
+    def step(self, action: int):
+        t0 = time.perf_counter()
+        out = self._env.step(action)
+        self._step_ms.append(1e3 * (time.perf_counter() - t0))
+        return out
+
+    def reset(self) -> np.ndarray:
+        return self._env.reset()
+
+    def drain_step_ms(self) -> list[float]:
+        out = list(self._step_ms)
+        self._step_ms.clear()
+        return out
+
+    def __getattr__(self, name: str):
+        return getattr(self._env, name)
 
 
 def make_env(cfg: EnvConfig, seed: int = 0) -> Env:

@@ -16,10 +16,12 @@ single-actor annealed schedule.
 
 from __future__ import annotations
 
+import logging
 import multiprocessing as mp
 import os
 import threading
 import time
+from collections import deque
 from typing import Any
 
 import numpy as np
@@ -133,12 +135,27 @@ class _ActorComms:
     (VERDICT r3 weak #6).
     """
 
+    # satellite telemetry/alerting knobs (class-level so tests can tune):
+    # after HB_WARN_AFTER consecutive heartbeat failures, log a warning at
+    # most every HB_WARN_PERIOD seconds — backoff alone is silent, and a
+    # fleet quietly riding data traffic is exactly what r4 asked to surface
+    HB_WARN_AFTER = 8
+    HB_WARN_PERIOD = 30.0
+
     def __init__(self, cfg: Config, client, qnet, rng):
         self._client = client
         self._qnet = qnet
         self._period = max(cfg.actors.param_sync_period, 1)
         self._phase = int(rng.integers(self._period))
         self._version = -1
+        # telemetry buffers, drained into tm_* arrays on each transition
+        # flush (bounded: a stalled flush must not grow them unboundedly);
+        # appended from the env loop (_pull_ms) and the beat thread
+        # (_hb_ms) — deque ops are atomic under the GIL
+        self._pull_ms: deque = deque(maxlen=64)
+        self._hb_ms: deque = deque(maxlen=64)
+        self._hb_failures = 0
+        self._hb_last_warn = 0.0
         # the beat paces on a PROCESS-LOCAL event, never on the shared
         # multiprocessing stop event: a thread parked in mp.Event.wait()
         # registers as a sleeper on the event's shared Condition, and a
@@ -171,7 +188,10 @@ class _ActorComms:
                 continue  # loop wedged past the budget: go silent (the
                 #           supervisor respawns); resume if it recovers
             try:
+                t0 = time.perf_counter()
                 self._client.call("heartbeat")
+                self._hb_ms.append(1e3 * (time.perf_counter() - t0))
+                self._hb_failures = 0
                 backoff = period
             except (ConnectionError, OSError, ValueError):
                 # server gone, mid-restart, or stream desync (recv_msg
@@ -180,9 +200,16 @@ class _ActorComms:
                 # off (cap ~8×period) and keep trying — the env loop
                 # discovers a dead learner on its own wire calls
                 backoff = min(backoff * 2, period * 8)
+                self._hb_failures += 1
+                now = time.monotonic()
+                if (self._hb_failures >= self.HB_WARN_AFTER
+                        and now - self._hb_last_warn > self.HB_WARN_PERIOD):
+                    self._hb_last_warn = now
+                    logging.getLogger(__name__).warning(
+                        "heartbeat: %d consecutive failures (server "
+                        "unreachable?); retrying every %.1fs",
+                        self._hb_failures, backoff)
             except Exception as e:  # noqa: BLE001 — protocol desync etc.
-                import logging
-
                 logging.getLogger(__name__).warning(
                     "heartbeat thread exiting on %s: %s",
                     type(e).__name__, e)
@@ -194,11 +221,26 @@ class _ActorComms:
     def maybe_pull(self, steps: int) -> None:
         self._watermark = time.monotonic()  # loop progress (beat gate)
         if steps == 0 or (steps + self._phase) % self._period == 0:
+            t0 = time.perf_counter()
             version, weights = self._client.get_params(
                 have_version=self._version)
+            # time the full round trip incl. installing fresh weights —
+            # that is the latency the env loop actually pays
             if weights is not None:
                 self._qnet.set_weights(weights)
                 self._version = version
+            self._pull_ms.append(1e3 * (time.perf_counter() - t0))
+
+    def drain_telemetry(self) -> dict[str, np.ndarray]:
+        """Buffered latency samples as ``tm_*`` wire arrays (cleared on
+        read); the server folds them into its fleet histograms."""
+        out: dict[str, np.ndarray] = {}
+        for key, q in (("tm_param_pull_ms", self._pull_ms),
+                       ("tm_heartbeat_rtt_ms", self._hb_ms)):
+            if q:
+                samples = [q.popleft() for _ in range(len(q))]
+                out[key] = np.asarray(samples, np.float32)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -228,7 +270,7 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     jax.config.update("jax_platforms", "cpu")
     # late imports: after the platform pin, inside the child process
     from distributed_deep_q_tpu.actors.game import (
-        FrameStacker, NStepAccumulator, make_env)
+        FrameStacker, NStepAccumulator, StepLatencyEnv, make_env)
     from distributed_deep_q_tpu.models.qnet import QNet
     from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedClient
 
@@ -238,8 +280,8 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     # decorrelate instead of repeating each other (config 5 full shape)
     gid = actor_id + cfg.actors.actor_id_offset
     fleet = cfg.actors.fleet_size or cfg.actors.num_actors
-    env = make_env(env_for_actor(cfg.env, gid),
-                   seed=cfg.train.seed + 1000 * (gid + 1))
+    env = StepLatencyEnv(make_env(env_for_actor(cfg.env, gid),
+                                  seed=cfg.train.seed + 1000 * (gid + 1)))
     cfg.net.num_actions = env.num_actions
     qnet = QNet(cfg.net, seed=cfg.train.seed,
                 obs_dim=int(np.prod(env.obs_shape)))
@@ -291,6 +333,10 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
             }
         payload["episodes"] = episodes
         payload["ep_returns"] = np.asarray(ep_returns, np.float32)
+        payload.update(comms.drain_telemetry())
+        step_ms = env.drain_step_ms()
+        if step_ms:
+            payload["tm_env_step_ms"] = np.asarray(step_ms, np.float32)
         client.add_transitions(**payload)
         for v in chunk.values():
             v.clear()
@@ -397,6 +443,10 @@ def _recurrent_actor_loop(cfg: Config, env, qnet, client, rng, eps: float,
         payload["episodes"] = episodes
         payload["ep_returns"] = np.asarray(ep_returns, np.float32)
         payload["env_steps"] = env_steps_since
+        payload.update(comms.drain_telemetry())
+        step_ms = getattr(env, "drain_step_ms", lambda: [])()
+        if step_ms:
+            payload["tm_env_step_ms"] = np.asarray(step_ms, np.float32)
         client.add_transitions(**payload)
         seqs.clear()
         ep_returns.clear()
@@ -708,7 +758,10 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                 writeback.push(m["index"], m["td_abs"], sampled_at)
 
             if gstep % cfg.actors.param_sync_period == 0:
+                t0 = time.perf_counter()
                 server.publish_params(solver.get_weights())
+                metrics.observe("learner/publish_params_ms",
+                                1e3 * (time.perf_counter() - t0))
 
             if ckpt and gstep % cfg.train.checkpoint_every == 0:
                 ckpt.save(solver.state, extra={"env_steps": server.env_steps})
@@ -724,7 +777,12 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                     "grad_steps_per_s": metrics.rate("grad_steps"),
                     "actor_restarts": sup.restarts,
                 }
-                metrics.log(gstep, **summary, **timer.summary())
+                # one record carries the whole telemetry spine: per-phase
+                # times, per-RPC-method latency/size percentiles, queue
+                # gauges, and the fleet counters actors flushed back
+                metrics.log(gstep, **summary, **timer.summary(),
+                            **server.telemetry_summary(),
+                            **metrics.telemetry())
     finally:
         trace.close()
         if stager is not None:
@@ -836,6 +894,8 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
                                    lock=server.replay_lock,
                                    to_host=local_rows if pc > 1 else None)
     summary: dict = {}
+    from distributed_deep_q_tpu.profiling import StepTimer
+    timer = StepTimer()
     try:
         if pc == 1:
             while not replay.ready(learn_start_seqs):
@@ -859,21 +919,29 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
                 # must be enqueued before the handle can be invalidated
                 # (same discipline as the DeviceFrameReplay loop above)
                 with server.replay_lock:
-                    batch = replay.sample(local_batch)
+                    with timer.phase("sample"):
+                        batch = replay.sample(local_batch)
                     sampled_at = batch.pop("_sampled_at")
-                    m = solver.train_step_from_ring(replay, batch)
+                    with timer.phase("dispatch"):
+                        m = solver.train_step_from_ring(replay, batch)
             else:
                 with server.replay_lock:
-                    batch = replay.sample(local_batch)
+                    with timer.phase("sample"):
+                        batch = replay.sample(local_batch)
                     sampled_at = batch.pop("_sampled_at")
-                m = solver.train_step(batch)
+                with timer.phase("dispatch"):
+                    m = solver.train_step(batch)
             metrics.count("grad_steps")
+            timer.step_done()
 
             if writeback is not None:
                 writeback.push(m["index"], m["td_abs"], sampled_at)
 
             if gstep % cfg.actors.param_sync_period == 0:
+                t0 = time.perf_counter()
                 server.publish_params(solver.get_weights())
+                metrics.observe("learner/publish_params_ms",
+                                1e3 * (time.perf_counter() - t0))
             if ckpt and gstep % cfg.train.checkpoint_every == 0:
                 ckpt.save(solver.state, extra={"env_steps": server.env_steps})
             if gstep % log_every == 0:
@@ -886,7 +954,9 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
                     "grad_steps_per_s": metrics.rate("grad_steps"),
                     "actor_restarts": sup.restarts,
                 }
-                metrics.log(gstep, **summary)
+                metrics.log(gstep, **summary, **timer.summary(),
+                            **server.telemetry_summary(),
+                            **metrics.telemetry())
     finally:
         sup.stop()
         server.close()

@@ -1,0 +1,88 @@
+"""jax version-compat shims.
+
+The codebase targets the current jax API; this module absorbs the
+renames between jax releases so the package imports on both:
+
+- ``shard_map`` moved from ``jax.experimental.shard_map`` to the ``jax``
+  namespace, and its replication-check kwarg was renamed
+  ``check_rep`` → ``check_vma``. The shim exposes the NEW spelling
+  (``check_vma``) and translates down when running on an older jax.
+- ``optax.safe_int32_increment`` was renamed ``optax.safe_increment``.
+- ``jax.Array.format`` (layout+sharding handle) does not exist on older
+  jax; ``array_format`` falls back to the bare sharding (losing only the
+  entry-layout pin, not correctness).
+- the ``jax_num_cpu_devices`` config option is newer than the
+  ``--xla_force_host_platform_device_count`` XLA flag it replaced;
+  ``set_cpu_device_count`` speaks whichever this jax understands.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+try:  # jax >= 0.6: first-class export, check_vma kwarg
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+
+    _NATIVE = True
+except ImportError:  # older jax: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _NATIVE = False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs,
+              check_vma: bool | None = None, **kw: Any):
+    if check_vma is not None:
+        kw["check_vma" if _NATIVE else "check_rep"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
+
+try:  # optax >= 0.2.2
+    from optax import safe_increment
+except ImportError:
+    from optax import safe_int32_increment as safe_increment  # noqa: F401
+
+
+def array_format(x):
+    """``x.format`` where jax.Array has it, else the bare sharding — both
+    are accepted as jit in_/out_shardings; only the explicit entry-layout
+    pin is lost on the fallback path."""
+    try:
+        return x.format
+    except AttributeError:
+        return x.sharding
+
+
+_XLA_CPU_FLAG = "--xla_force_host_platform_device_count="
+
+
+def set_cpu_device_count(n: int, *, exact: bool = False) -> None:
+    """Pre-backend-init: request ``n`` virtual CPU devices.
+
+    By default never *lowers* an earlier request — a small mesh built
+    first must not cap later larger ones. ``exact=True`` overrides that
+    (multihost sizes each process's local slice exactly, even when the
+    parent's environment asked for more). On jax without the
+    ``jax_num_cpu_devices`` config option this routes through XLA_FLAGS,
+    which the backend reads at first init.
+    """
+    import jax
+
+    try:
+        if not exact:
+            n = max(getattr(jax.config, "jax_num_cpu_devices", -1) or -1, n)
+        jax.config.update("jax_num_cpu_devices", n)
+        return
+    except AttributeError:
+        pass
+    kept = []
+    for tok in os.environ.get("XLA_FLAGS", "").split():
+        if tok.startswith(_XLA_CPU_FLAG):
+            if not exact:
+                n = max(n, int(tok[len(_XLA_CPU_FLAG):]))
+        else:
+            kept.append(tok)
+    kept.append(f"{_XLA_CPU_FLAG}{n}")
+    os.environ["XLA_FLAGS"] = " ".join(kept)

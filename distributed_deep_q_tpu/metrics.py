@@ -4,14 +4,122 @@ The reference logs via stdout prints + Spark UI [R]; here metrics are
 structured counters written as JSONL (machine-readable for the bench
 harness) with optional TensorBoard mirroring. The north-star counters —
 grad-steps/sec, env-steps/sec, eval return [M] — are first-class.
+
+Telemetry layer (observability spine): ``Histogram`` is a streaming
+log-bucketed histogram (fixed bucket edges, O(1) observe, p50/p95/p99
+summaries) used for latency/size distributions across the distributed
+seams — RPC method latency, θ-pull round trips, per-phase step times.
+``Metrics`` additionally holds named gauges (point-in-time values such
+as queue depths — the signal the round-5 ingest OOM lacked) and named
+histograms; ``telemetry()`` flattens both into scalar keys for the same
+JSONL/TensorBoard sinks that carry the counters.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import time
 from collections import deque
 from typing import Any, IO
+
+_PCTS = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
+
+
+class Histogram:
+    """Streaming histogram over fixed log-spaced buckets.
+
+    Values land in geometric buckets spanning [lo, hi) with
+    ``per_decade`` buckets per factor of 10, plus an underflow and an
+    overflow bucket — O(1) memory regardless of observation count, so
+    it is safe on hot paths (RPC dispatch, per-step phase timing).
+    Percentile estimates interpolate within the winning bucket and are
+    clamped to the observed min/max, so single-value histograms report
+    that value exactly.
+    """
+
+    def __init__(self, lo: float = 1e-3, hi: float = 1e5,
+                 per_decade: int = 10):
+        if not (0 < lo < hi):
+            raise ValueError(f"need 0 < lo < hi, got lo={lo} hi={hi}")
+        self._lo = float(lo)
+        self._log_lo = math.log(lo)
+        self._scale = per_decade / math.log(10.0)
+        # interior buckets + underflow [0] + overflow [-1]
+        n_interior = int(math.ceil((math.log(hi) - self._log_lo)
+                                   * self._scale))
+        self._counts = [0] * (n_interior + 2)
+        self.count = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+
+    def _edge(self, i: int) -> float:
+        """Lower edge of interior bucket i (1-based in self._counts)."""
+        return math.exp(self._log_lo + (i - 1) / self._scale)
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        if math.isnan(v):
+            return
+        self.count += 1
+        self.total += v
+        self.vmin = min(self.vmin, v)
+        self.vmax = max(self.vmax, v)
+        if v < self._lo:
+            idx = 0
+        else:
+            idx = 1 + int((math.log(v) - self._log_lo) * self._scale)
+            idx = min(idx, len(self._counts) - 1)
+        self._counts[idx] += 1
+
+    def observe_many(self, values) -> None:
+        for v in values:
+            self.observe(v)
+
+    def percentile(self, q: float) -> float:
+        if self.count == 0:
+            return float("nan")
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self._counts):
+            cum += c
+            if cum >= target and c > 0:
+                if i == 0:
+                    est = self._lo
+                elif i == len(self._counts) - 1:
+                    est = self.vmax
+                else:
+                    # interpolate inside the bucket by rank fraction
+                    frac = 1.0 - (cum - target) / c
+                    left, right = self._edge(i), self._edge(i + 1)
+                    est = left + frac * (right - left)
+                return min(max(est, self.vmin), self.vmax)
+        return self.vmax
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else float("nan")
+
+    def summary(self, prefix: str = "") -> dict[str, float]:
+        """Flat scalar summary: ``{prefix}_count/mean/max/p50/p95/p99``
+        (empty dict while no observations — absent beats NaN in JSONL)."""
+        if self.count == 0:
+            return {}
+        sep = "_" if prefix else ""
+        out = {f"{prefix}{sep}count": self.count,
+               f"{prefix}{sep}mean": self.mean,
+               f"{prefix}{sep}max": self.vmax}
+        for name, q in _PCTS:
+            out[f"{prefix}{sep}{name}"] = self.percentile(q)
+        return out
+
+    def reset(self) -> None:
+        self._counts = [0] * len(self._counts)
+        self.count = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
 
 
 class Metrics:
@@ -36,10 +144,38 @@ class Metrics:
         self._t0 = time.monotonic()
         self._counters: dict[str, int] = {}
         self._marks: dict[str, tuple[float, int]] = {}
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, Histogram] = {}
 
     # -- counters with rates (grad-steps/sec, env-steps/sec) ---------------
     def count(self, name: str, inc: int = 1) -> None:
         self._counters[name] = self._counters.get(name, 0) + inc
+
+    # -- gauges + histograms (telemetry spine) ------------------------------
+    def gauge(self, name: str, value: float) -> None:
+        """Set a point-in-time value (queue depth, version lag, ...)."""
+        self._gauges[name] = float(value)
+
+    def histogram(self, name: str, lo: float = 1e-3, hi: float = 1e5,
+                  per_decade: int = 10) -> Histogram:
+        """Get-or-create the named histogram (custom range on creation)."""
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists[name] = Histogram(lo, hi, per_decade)
+        return h
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one observation into the named histogram."""
+        self.histogram(name).observe(value)
+
+    def telemetry(self) -> dict[str, float]:
+        """Flatten gauges + histogram summaries into scalar keys for
+        ``log()``: gauges pass through by name, each histogram ``h``
+        contributes ``h_count/mean/max/p50/p95/p99``."""
+        out = dict(self._gauges)
+        for name, h in self._hists.items():
+            out.update(h.summary(prefix=name))
+        return out
 
     def rate(self, name: str) -> float:
         """Rate of a counter since the last time rate() was called on it."""

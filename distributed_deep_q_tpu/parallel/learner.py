@@ -28,7 +28,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import lax, shard_map
+from jax import lax
+
+from distributed_deep_q_tpu.compat import safe_increment, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_deep_q_tpu.config import TrainConfig
@@ -36,6 +38,12 @@ from distributed_deep_q_tpu.ops.losses import bellman_targets, dqn_loss
 from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
 from distributed_deep_q_tpu.parallel.multihost import (
     global_batch, put_replicated)
+
+
+# Adam moment decays, shared by ``make_optimizer`` (the state-structure
+# builder) and ``fused_adam_step`` (the hot path) so the two can never
+# drift apart — their bitwise equivalence is load-bearing for checkpoints.
+ADAM_B1, ADAM_B2 = 0.9, 0.999
 
 
 class TrainState(flax.struct.PyTreeNode):
@@ -58,7 +66,7 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     op-count-bound there). rmsprop keeps the optax update path with
     ``clip_grads``."""
     if cfg.optimizer == "adam":
-        opt = optax.adam(cfg.lr, eps=cfg.adam_eps,
+        opt = optax.adam(cfg.lr, b1=ADAM_B1, b2=ADAM_B2, eps=cfg.adam_eps,
                          mu_dtype=jnp.dtype(cfg.adam_mu_dtype))
     elif cfg.optimizer == "rmsprop":
         opt = optax.rmsprop(cfg.lr, decay=0.95, eps=1e-2, centered=True)
@@ -114,8 +122,8 @@ def fused_adam_step(cfg: TrainConfig, grads: Any, opt_state: Any,
         def rebuild(s):
             return (opt_state[0], (s,) + tuple(inner[1:])) \
                 + tuple(opt_state[2:])
-    b1, b2 = 0.9, 0.999
-    count = optax.safe_increment(adam_state.count)
+    b1, b2 = ADAM_B1, ADAM_B2
+    count = safe_increment(adam_state.count)
     c = count.astype(jnp.float32)
     bc1 = 1.0 - b1 ** c
     bc2 = 1.0 - b2 ** c

@@ -39,8 +39,8 @@ def _cpu_devices(n: int) -> list[jax.Device]:
             # sitecustomize JAX_PLATFORMS latch). Only ever *raise* the device
             # count — a small mesh built first must not cap later larger ones.
             jax.config.update("jax_platforms", "cpu")
-            cur = getattr(jax.config, "jax_num_cpu_devices", -1)
-            jax.config.update("jax_num_cpu_devices", max(cur, n))
+            from distributed_deep_q_tpu.compat import set_cpu_device_count
+            set_cpu_device_count(n)
         except Exception:
             pass
     devs = jax.devices("cpu")

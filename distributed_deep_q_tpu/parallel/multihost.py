@@ -65,8 +65,9 @@ def initialize_multihost(cfg: MeshConfig) -> None:
         # same pre-init pattern as parallel.mesh._cpu_devices: override the
         # container's platform latch, then size this process's local slice
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices",
-                          cfg.num_fake_devices // cfg.num_processes)
+        from distributed_deep_q_tpu.compat import set_cpu_device_count
+        set_cpu_device_count(cfg.num_fake_devices // cfg.num_processes,
+                             exact=True)
         # cross-process collectives on the CPU backend go through gloo
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     kwargs: dict[str, Any] = {}

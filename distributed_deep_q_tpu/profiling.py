@@ -6,7 +6,10 @@ rebuild gets two first-class tools:
 - ``StepTimer`` — cheap host-side wall-time breakdown of the train loop's
   phases (``sample`` / ``host_compose`` / ``dispatch`` / ``device``),
   accumulated per step and emitted through ``Metrics`` as
-  ``time_<phase>_ms`` scalars. Dispatch is what the host pays to enqueue
+  ``time_<phase>_ms`` scalars, plus per-phase ``time_<phase>_p50_ms`` /
+  ``time_<phase>_p99_ms`` percentiles from a streaming histogram — the
+  mean hides the stall spikes (GC, lock contention, an actor flush
+  landing mid-sample) that the p99 exists to expose. Dispatch is what the host pays to enqueue
   the XLA program (µs when the pipeline is healthy); ``device`` is measured
   by blocking on the step's outputs, so it's recorded only on logging
   steps — blocking every step would serialize the pipeline the timer
@@ -26,6 +29,8 @@ from collections import defaultdict
 from typing import Iterator
 
 import jax
+
+from distributed_deep_q_tpu.metrics import Histogram
 
 
 class StepTimer:
@@ -50,6 +55,7 @@ class StepTimer:
 
     def __init__(self) -> None:
         self._acc: dict[str, float] = defaultdict(float)
+        self._hists: dict[str, Histogram] = {}
         self._steps = 0
         self._last_step_t: float | None = None
         self._step_total = 0.0
@@ -60,7 +66,12 @@ class StepTimer:
         try:
             yield
         finally:
-            self._acc[name] += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self._acc[name] += dt
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            h.observe(1e3 * dt)
 
     def measure_device(self, outputs) -> None:
         """Block until ``outputs`` (the step's device results) are done and
@@ -84,8 +95,13 @@ class StepTimer:
             out["time_device_ms"] = 1e3 * self._acc["device"]
         if self._steps > 1:
             out["time_step_ms"] = 1e3 * self._step_total / (self._steps - 1)
+        for name, h in self._hists.items():
+            if h.count:
+                out[f"time_{name}_p50_ms"] = h.percentile(0.50)
+                out[f"time_{name}_p99_ms"] = h.percentile(0.99)
         if reset:
             self._acc.clear()
+            self._hists.clear()
             self._steps = 0
             self._step_total = 0.0
             # drop the carried timestamp too: each window then averages

@@ -504,7 +504,7 @@ class DevicePERFrameReplay(DeviceFrameReplay):
                  num_streams: int = 1):
         import dataclasses
 
-        from jax import shard_map
+        from distributed_deep_q_tpu.compat import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from distributed_deep_q_tpu.ops.ring_gather import scatter_rows
@@ -590,7 +590,8 @@ class DevicePERFrameReplay(DeviceFrameReplay):
         # entry/exit layouts pinned to the live arrays' formats: XLA's
         # auto layout assignment may otherwise pick a transposed entry
         # layout for a metadata plane and relayout-copy it every flush
-        state_fmt = jax.tree.map(lambda x: x.format, self.dstate)
+        from distributed_deep_q_tpu.compat import array_format
+        state_fmt = jax.tree.map(array_format, self.dstate)
         self._write_full = jax.jit(
             shard_map(write, mesh=mesh,
                       in_specs=(state_spec,) + (P_(AXIS_DP),) * 8,

@@ -127,7 +127,13 @@ def send_msg(sock: socket.socket, msg: dict[str, Any]) -> None:
 
 
 def recv_msg(sock: socket.socket) -> dict[str, Any]:
+    return recv_msg_sized(sock)[0]
+
+
+def recv_msg_sized(sock: socket.socket) -> tuple[dict[str, Any], int]:
+    """Receive one message and its wire payload size in bytes — the size
+    feeds the server's per-method payload histograms without re-encoding."""
     (length,) = struct.unpack("<I", _recv_exact(sock, 4))
     if length > MAX_MESSAGE:
         raise ValueError(f"message of {length} bytes exceeds cap")
-    return decode(_recv_exact(sock, length))
+    return decode(_recv_exact(sock, length)), length

@@ -30,7 +30,104 @@ from typing import Any
 
 import numpy as np
 
-from distributed_deep_q_tpu.rpc.protocol import encode, recv_msg, send_msg
+from distributed_deep_q_tpu.metrics import Histogram
+from distributed_deep_q_tpu.rpc.protocol import (
+    encode, recv_msg, recv_msg_sized, send_msg)
+
+
+class ServerTelemetry:
+    """Server-side RPC + fleet accounting (observability spine).
+
+    Every served request records into per-method latency (ms) and
+    request-payload-size (bytes) histograms; actors piggyback their own
+    counters (``tm_*`` keys on ``add_transitions`` — θ-pull latency,
+    heartbeat RTT, env-step time) which aggregate into fleet-wide
+    histograms plus per-actor env-step counters, so the learner-side
+    ``Metrics`` holds a fleet view without any extra RPC traffic.
+    One lock guards all structures: they are touched from every serve
+    thread.
+    """
+
+    # actor-shipped sample arrays → fleet histogram names
+    ACTOR_KEYS = {
+        "tm_param_pull_ms": "fleet/param_pull_ms",
+        "tm_heartbeat_rtt_ms": "fleet/heartbeat_rtt_ms",
+        "tm_env_step_ms": "fleet/env_step_ms",
+    }
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.method_calls: dict[str, int] = {}
+        self.method_lat: dict[str, Histogram] = {}
+        self.method_bytes: dict[str, Histogram] = {}
+        self.fleet: dict[str, Histogram] = {}
+        self.actor_env_steps: dict[int, int] = {}
+        self.last_pulled_version: dict[int, int] = {}
+
+    def record_call(self, method: str, ms: float, nbytes: int) -> None:
+        with self._lock:
+            self.method_calls[method] = self.method_calls.get(method, 0) + 1
+            lat = self.method_lat.get(method)
+            if lat is None:
+                lat = self.method_lat[method] = Histogram(1e-3, 1e5)
+            lat.observe(ms)
+            size = self.method_bytes.get(method)
+            if size is None:
+                # requests span ~60 B heartbeats to multi-MB θ frames
+                size = self.method_bytes[method] = Histogram(1.0, 1e10,
+                                                             per_decade=5)
+            size.observe(nbytes)
+
+    def record_pull(self, actor_id: int, version: int) -> None:
+        if actor_id >= 0:
+            with self._lock:
+                self.last_pulled_version[actor_id] = version
+
+    def on_transitions(self, actor_id: int, n: int,
+                       req: dict[str, Any]) -> None:
+        """Account one add_transitions: per-actor env steps + any
+        piggybacked ``tm_*`` counter arrays into the fleet histograms."""
+        with self._lock:
+            if actor_id >= 0:
+                self.actor_env_steps[actor_id] = \
+                    self.actor_env_steps.get(actor_id, 0) + n
+            for key, name in self.ACTOR_KEYS.items():
+                samples = req.get(key)
+                if samples is None:
+                    continue
+                h = self.fleet.get(name)
+                if h is None:
+                    h = self.fleet[name] = Histogram(1e-3, 1e5)
+                h.observe_many(np.atleast_1d(samples))
+
+    def summary(self, params_version: int = 0) -> dict[str, float]:
+        """Flat scalar view for ``Metrics.log`` / the ``stats`` RPC:
+        per-method call counts + latency/size percentiles, fleet
+        histograms, and the params-version lag gauge (how far the most
+        stale actor's pulled θ trails the published version)."""
+        with self._lock:
+            out: dict[str, float] = {}
+            for m, c in self.method_calls.items():
+                out[f"rpc/{m}_calls"] = c
+            for m, h in self.method_lat.items():
+                out.update(h.summary(prefix=f"rpc/{m}_ms"))
+            for m, h in self.method_bytes.items():
+                out[f"rpc/{m}_bytes_p95"] = h.percentile(0.95)
+                out[f"rpc/{m}_bytes_max"] = h.vmax
+            for name, h in self.fleet.items():
+                out.update(h.summary(prefix=name))
+            out["queue/params_version"] = params_version
+            if self.last_pulled_version:
+                out["queue/params_version_lag"] = params_version - min(
+                    self.last_pulled_version.values())
+            return out
+
+    def per_actor_env_steps(self) -> tuple[np.ndarray, np.ndarray]:
+        with self._lock:
+            ids = sorted(self.actor_env_steps)
+            return (np.asarray(ids, np.int64),
+                    np.asarray([self.actor_env_steps[i] for i in ids],
+                               np.int64))
 
 
 class ReplayFeedServer:
@@ -38,6 +135,7 @@ class ReplayFeedServer:
 
     def __init__(self, replay, host: str = "127.0.0.1", port: int = 0):
         self.replay = replay
+        self.telemetry = ServerTelemetry()
         # RLock: stats/mean_recent_return may be read under an already-held
         # guard (e.g. inside the add_transitions/stats handlers)
         self.replay_lock = threading.RLock()
@@ -103,12 +201,18 @@ class ReplayFeedServer:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
             while not self._stop.is_set():
-                req = recv_msg(conn)
+                req, nbytes = recv_msg_sized(conn)
+                t0 = time.perf_counter()
                 resp = self._dispatch(req)
                 if isinstance(resp, (bytes, bytearray)):
                     conn.sendall(resp)  # pre-encoded frame (θ snapshot)
                 else:
                     send_msg(conn, resp)
+                # latency covers dispatch + response serialization + send —
+                # what the actor actually waits on past its own upload
+                self.telemetry.record_call(
+                    str(req.get("method")),
+                    1e3 * (time.perf_counter() - t0), nbytes)
         except (ConnectionError, OSError):
             pass  # actor went away; supervisor handles liveness
         finally:
@@ -149,12 +253,14 @@ class ReplayFeedServer:
                 for r in np.atleast_1d(req.get("ep_returns",
                                                np.zeros(0, np.float32))):
                     self.returns.append(float(r))
+            self.telemetry.on_transitions(actor_id, n, req)
             return {"ok": True, "env_steps": self.env_steps}
 
         if method == "get_params":
             with self._params_lock:
                 if self._params_wire is None:
                     return {"version": 0}
+                self.telemetry.record_pull(actor_id, self._params_version)
                 if req.get("have_version") == self._params_version:
                     return {"version": self._params_version}  # no-op refresh
                 return self._params_wire  # cached frame, sent verbatim
@@ -173,14 +279,43 @@ class ReplayFeedServer:
 
         if method == "stats":
             with self.replay_lock:
-                return {
+                out = {
                     "env_steps": self.env_steps,
                     "episodes": self.episodes,
-                    "replay_size": len(self.replay),
+                    "replay_size": (len(self.replay)
+                                    if self.replay is not None else 0),
                     "mean_return": self.mean_recent_return(),
                 }
+            # server health for actors/bench/tests without reaching into
+            # internals: per-method latency/size summaries, queue gauges,
+            # and the fleet counters the actors flushed back
+            out.update(self.telemetry_summary())
+            ids, steps = self.telemetry.per_actor_env_steps()
+            out["actor_ids"] = ids
+            out["actor_env_steps"] = steps
+            return out
 
         return {"error": f"unknown method {method!r}"}
+
+    # -- telemetry ----------------------------------------------------------
+
+    def telemetry_summary(self) -> dict[str, float]:
+        """Flat scalar server-health view (histogram summaries + queue
+        gauges), ready for ``Metrics.log(step, **summary)`` on the
+        learner and for the ``stats`` RPC. Queue gauges cover replay
+        fill, staged-but-unflushed rows (the round-5 ingest-OOM signal),
+        and the fleet's params-version lag."""
+        with self._params_lock:
+            version = self._params_version
+        out = self.telemetry.summary(params_version=version)
+        if self.replay is not None:
+            with self.replay_lock:
+                out["queue/replay_size"] = len(self.replay)
+                pending = getattr(self.replay, "pending_rows", None)
+                if pending is not None:
+                    out["queue/staged_rows"] = int(pending())
+        out["fleet/actors_seen"] = len(self.last_seen)
+        return out
 
 
 def _takes_stream(replay) -> bool:

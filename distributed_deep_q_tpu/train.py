@@ -288,7 +288,12 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
                             "grad_steps_per_s": metrics.rate("grad_steps"),
                             "env_steps_per_s": metrics.rate("env_steps"),
                         }
-                        metrics.log(solver.step, **summary, **timer.summary())
+                        metrics.gauge("queue/replay_size", len(replay))
+                        pending = getattr(replay, "pending_rows", None)
+                        if pending is not None:
+                            metrics.gauge("queue/staged_rows", pending())
+                        metrics.log(solver.step, **summary, **timer.summary(),
+                                    **metrics.telemetry())
 
             if (cfg.train.eval_every and t % cfg.train.eval_every == 0):
                 ret = evaluate(solver, cfg)
@@ -428,6 +433,16 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
         gsteps = solver.step
     persist = cfg.replay.persist_path
     if persist and jax.process_count() > 1:
+        if device_seq:
+            # the device sequence ring is a GLOBAL mesh array: each
+            # process's shard file would hold only its addressable slice,
+            # and resume would reassemble a buffer whose sampling state no
+            # longer matches the mesh — silent corruption. Refuse loudly.
+            raise ValueError(
+                "replay.persist_path is not supported with a device-"
+                "resident DeviceSequenceReplay under multi-process "
+                f"(process_count={jax.process_count()}); set "
+                "replay.device_resident=false or drop persist_path")
         # per-process shard files (same rule as train_single_process): a
         # shared path would race on save and clone one process's state
         # onto every host on resume
@@ -496,7 +511,11 @@ def train_recurrent(cfg: Config, metrics: Metrics | None = None,
                     "grad_steps_per_s": metrics.rate("grad_steps"),
                     "env_steps_per_s": metrics.rate("env_steps"),
                 }
-                metrics.log(gsteps, **summary)
+                metrics.gauge("queue/replay_size", len(replay))
+                pending = getattr(replay, "pending_rows", None)
+                if pending is not None:
+                    metrics.gauge("queue/staged_rows", pending())
+                metrics.log(gsteps, **summary, **metrics.telemetry())
 
     if writeback:
         writeback.drain()

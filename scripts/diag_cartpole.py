@@ -9,7 +9,8 @@ import time
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from distributed_deep_q_tpu.compat import set_cpu_device_count
+set_cpu_device_count(8)
 
 import numpy as np
 

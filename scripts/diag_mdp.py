@@ -11,7 +11,8 @@ Q*(s0,a1)=1+0.9*V(s1)=1.9 ; Q*(s0,a0)=0+0.9*V(s0)=0.9*1.9=1.71
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from distributed_deep_q_tpu.compat import set_cpu_device_count
+set_cpu_device_count(8)
 
 import numpy as np
 

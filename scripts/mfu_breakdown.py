@@ -136,7 +136,8 @@ def main() -> None:
         # the container's sitecustomize pre-imports jax pinned to the TPU
         # platform; env JAX_PLATFORMS=cpu is too late — override via config
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
+        from distributed_deep_q_tpu.compat import set_cpu_device_count
+        set_cpu_device_count(8)
     import jax.numpy as jnp
 
     from bench import peak_flops_for
@@ -171,7 +172,7 @@ def main() -> None:
     # -- full_hostb: same step, batch pre-composed on device --------------
     from distributed_deep_q_tpu.replay.device_ring import compose_stacks
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from distributed_deep_q_tpu.compat import shard_map
     from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
 
     compose = jax.jit(shard_map(

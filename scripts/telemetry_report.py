@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Human-readable report over a run's metrics JSONL (observability spine).
+
+Reads the JSONL a ``Metrics(jsonl_path=...)`` run wrote and prints:
+
+- run overview — record/step span, wall time, throughput counters;
+- training curve tail — loss / q_mean / return at the end of the run;
+- per-phase step breakdown — ``time_<phase>_ms`` means plus the
+  streaming-histogram p50/p99 where the run recorded them;
+- RPC server table — per-method call counts, latency percentiles and
+  payload sizes (``rpc/<method>_*`` keys from the ``stats`` RPC /
+  ``telemetry_summary``);
+- fleet counters — θ-pull, heartbeat RTT, env-step latency histograms
+  the actors flushed back (``fleet/*``);
+- queue gauges — replay/staged-row depths and params-version lag
+  (``queue/*``), the r5 host-OOM early-warning signals;
+- anomalies — bad JSON, non-monotonic steps, logging gaps, stalled
+  counters, non-finite values.
+
+Pure stdlib (json/math/argparse): usable on any host with the JSONL file,
+no jax/numpy required. ``load_records`` / ``validate_records`` are
+importable by tests and other tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+# suffixes Histogram.summary() emits, in display order
+HIST_SUFFIXES = ("count", "mean", "p50", "p95", "p99", "max")
+
+
+def load_records(path: str) -> list[dict]:
+    """Parse one JSONL file; raises ValueError naming the bad line."""
+    records = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{lineno}: invalid JSON ({e})")
+            if not isinstance(rec, dict):
+                raise ValueError(f"{path}:{lineno}: record is not an object")
+            records.append(rec)
+    return records
+
+
+def validate_records(records: list[dict]) -> list[str]:
+    """Structural problems: missing/non-monotonic ``step``, non-finite
+    values. Returns human-readable problem strings (empty = clean)."""
+    problems = []
+    last_step = None
+    for i, rec in enumerate(records):
+        if "step" not in rec:
+            problems.append(f"record {i}: missing 'step'")
+            continue
+        step = rec["step"]
+        if not isinstance(step, (int, float)):
+            problems.append(f"record {i}: non-numeric step {step!r}")
+            continue
+        if last_step is not None and step < last_step:
+            problems.append(
+                f"record {i}: step {step} < previous {last_step} "
+                "(non-monotonic)")
+        last_step = step
+        for k, v in rec.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                problems.append(f"record {i} (step {step}): {k} = {v}")
+    return problems
+
+
+def _series(records: list[dict], key: str) -> list:
+    return [r[key] for r in records if key in r]
+
+
+def _hist_groups(records: list[dict], prefix: str) -> dict[str, dict]:
+    """Latest value per histogram-summary group under ``prefix``:
+    ``{'fleet/param_pull_ms': {'count': ..., 'p50': ..., ...}, ...}``."""
+    groups: dict[str, dict] = {}
+    for rec in records:
+        for k, v in rec.items():
+            if not k.startswith(prefix):
+                continue
+            for suf in HIST_SUFFIXES:
+                if k.endswith(f"_{suf}"):
+                    groups.setdefault(k[: -len(suf) - 1], {})[suf] = v
+                    break
+    return groups
+
+
+def _fmt(v, width: int = 9) -> str:
+    if v is None:
+        return " " * (width - 1) + "-"
+    if isinstance(v, float) and not math.isfinite(v):
+        return f"{v!s:>{width}}"
+    if isinstance(v, float) and abs(v) < 1e5:
+        return f"{v:>{width}.2f}"
+    return f"{int(v):>{width}d}"
+
+
+def _table(title: str, rows: list[tuple], header: tuple,
+           out: list[str]) -> None:
+    if not rows:
+        return
+    out.append(f"\n== {title} ==")
+    name_w = max(len(str(r[0])) for r in rows + [header])
+    out.append("  " + str(header[0]).ljust(name_w)
+               + "".join(f"{h:>10}" for h in header[1:]))
+    for r in rows:
+        out.append("  " + str(r[0]).ljust(name_w)
+                   + "".join(" " + _fmt(v) for v in r[1:]))
+
+
+def _gap_anomalies(records: list[dict], factor: float = 5.0) -> list[str]:
+    """Logging gaps (wall-time deltas >> the median cadence) and stalled
+    throughput counters."""
+    out = []
+    ts = [r["t"] for r in records if isinstance(r.get("t"), (int, float))]
+    if len(ts) >= 4:
+        deltas = [b - a for a, b in zip(ts, ts[1:])]
+        med = sorted(deltas)[len(deltas) // 2]
+        if med > 0:
+            for i, d in enumerate(deltas):
+                if d > factor * med:
+                    out.append(
+                        f"logging gap: {d:.1f}s between records {i} and "
+                        f"{i + 1} (median cadence {med:.1f}s)")
+    for key in ("env_steps", "grad_steps_per_s"):
+        vals = _series(records, key)
+        if len(vals) >= 3 and vals[-1] == vals[-2] == vals[-3] \
+                and (key != "env_steps" or vals[-1] == vals[0]):
+            out.append(f"counter stalled: {key} flat at {vals[-1]} over the "
+                       "last 3 records")
+    return out
+
+
+def render_report(records: list[dict], last: int = 0) -> str:
+    if last:
+        records = records[-last:]
+    if not records:
+        return "no records"
+    out: list[str] = []
+    steps = _series(records, "step")
+    ts = _series(records, "t")
+    out.append("== run overview ==")
+    out.append(f"  records             {len(records)}")
+    if steps:
+        out.append(f"  step span           {steps[0]} .. {steps[-1]}")
+    if ts:
+        out.append(f"  wall span           {ts[-1] - ts[0]:.1f}s "
+                   f"(t={ts[0]:.1f} .. {ts[-1]:.1f})")
+    for key in ("grad_steps_per_s", "env_steps_per_s", "env_steps",
+                "replay_size", "actor_restarts"):
+        vals = [v for v in _series(records, key)
+                if isinstance(v, (int, float))]
+        if vals:
+            out.append(f"  {key:<19} last {_fmt(vals[-1]).strip()}   "
+                       f"max {_fmt(max(vals)).strip()}")
+
+    rows = []
+    for key in ("loss", "q_mean", "return_avg100", "eval_return", "epsilon"):
+        vals = [v for v in _series(records, key)
+                if isinstance(v, (int, float)) and math.isfinite(v)]
+        if vals:
+            rows.append((key, vals[0], vals[-1], min(vals), max(vals)))
+    _table("training curve", rows, ("metric", "first", "last", "min", "max"),
+           out)
+
+    # per-phase step breakdown: time_<phase>_ms (+ _p50_ms/_p99_ms)
+    phases: dict[str, dict] = {}
+    for rec in records:
+        for k, v in rec.items():
+            if not (k.startswith("time_") and k.endswith("_ms")):
+                continue
+            stem = k[5:-3].rstrip("_")  # 'sample', 'sample_p50', ...
+            for suf in ("p50", "p99"):
+                if stem.endswith(f"_{suf}"):
+                    phases.setdefault(stem[: -len(suf) - 1], {})[suf] = v
+                    break
+            else:
+                phases.setdefault(stem, {})["mean"] = v
+    rows = [(name, d.get("mean"), d.get("p50"), d.get("p99"))
+            for name, d in sorted(phases.items())]
+    _table("step phases (ms, latest window)", rows,
+           ("phase", "mean", "p50", "p99"), out)
+
+    # RPC server table — join the latency/bytes/calls keys per method
+    lat = _hist_groups(records, "rpc/")
+    methods: dict[str, dict] = {}
+    calls: dict[str, float] = {}
+    for rec in records:
+        for k, v in rec.items():
+            if k.startswith("rpc/") and k.endswith("_calls"):
+                calls[k[4:-6]] = v
+    for group, d in lat.items():
+        name = group[4:]
+        if name.endswith("_ms"):
+            methods.setdefault(name[:-3], {})["ms"] = d
+        elif name.endswith("_bytes"):
+            methods.setdefault(name[:-6], {})["bytes"] = d
+    rows = []
+    for m in sorted(set(methods) | set(calls)):
+        ms = methods.get(m, {}).get("ms", {})
+        by = methods.get(m, {}).get("bytes", {})
+        rows.append((m, calls.get(m), ms.get("p50"), ms.get("p95"),
+                     ms.get("p99"), ms.get("max"), by.get("p95")))
+    _table("rpc methods", rows, ("method", "calls", "ms_p50", "ms_p95",
+                                 "ms_p99", "ms_max", "B_p95"), out)
+
+    rows = [(name[6:], d.get("count"), d.get("p50"), d.get("p95"),
+             d.get("p99"), d.get("max"))
+            for name, d in sorted(_hist_groups(records, "fleet/").items())]
+    _table("fleet (actor-side, ms)", rows,
+           ("counter", "count", "p50", "p95", "p99", "max"), out)
+
+    rows = [(name[8:], d.get("count"), d.get("p50"), d.get("p99"),
+             d.get("max"))
+            for name, d in sorted(_hist_groups(records, "learner/").items())]
+    _table("learner (ms)", rows, ("counter", "count", "p50", "p99", "max"),
+           out)
+
+    rows = []
+    for key in sorted({k for r in records for k in r
+                       if k.startswith("queue/") or k == "fleet/actors_seen"}):
+        vals = [v for v in _series(records, key)
+                if isinstance(v, (int, float))]
+        if vals:
+            rows.append((key, vals[-1], min(vals), max(vals)))
+    _table("queue gauges", rows, ("gauge", "last", "min", "max"), out)
+
+    problems = validate_records(records) + _gap_anomalies(records)
+    out.append(f"\n== anomalies ({len(problems)}) ==")
+    for p in problems[:50]:
+        out.append(f"  ! {p}")
+    if len(problems) > 50:
+        out.append(f"  ... and {len(problems) - 50} more")
+    if not problems:
+        out.append("  none")
+    return "\n".join(out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="metrics JSONL file written by a run")
+    ap.add_argument("--last", type=int, default=0,
+                    help="only the last N records (default: all)")
+    args = ap.parse_args(argv)
+    try:
+        records = load_records(args.jsonl)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(render_report(records, last=args.last))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,7 +50,8 @@ def main() -> None:
         # pin the CPU platform + 8 virtual devices the conftest way
         import jax
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
+        from distributed_deep_q_tpu.compat import set_cpu_device_count
+        set_cpu_device_count(8, exact=True)
     # must precede any backend init — this is the whole API contract
     initialize_multihost(mesh_cfg)
 

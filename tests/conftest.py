@@ -10,7 +10,18 @@ JAX_PLATFORMS=axon; `jax.config.update` below overrides it *before* any
 backend is initialized (conftest runs before test modules import jax users).
 """
 
-import jax
+import os
+
+# the XLA_FLAGS route must be set before the backend initializes; it is
+# the only spelling older jax releases (< 0.4.32, no jax_num_cpu_devices
+# config option) understand, so set it unconditionally as the fallback
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # older jax: the XLA_FLAGS fallback above applies
+    pass

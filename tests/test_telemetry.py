@@ -1,0 +1,293 @@
+"""Telemetry spine (PR 1, observability): streaming-histogram math, the
+``stats`` RPC round trip against a live ``ReplayFeedServer`` (server-side
+counters must match what the actor fleet sent), the telemetry_report CLI,
+and the tier-1 JSONL contract — every ``Metrics.log`` record is valid JSON
+with a monotonic step."""
+
+import json
+import math
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from distributed_deep_q_tpu.metrics import Histogram, Metrics
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "scripts"))
+
+from telemetry_report import (  # noqa: E402
+    load_records, render_report, validate_records)
+
+
+# -- histogram math ---------------------------------------------------------
+
+
+def test_histogram_single_value_is_exact():
+    h = Histogram()
+    h.observe(7.3)
+    s = h.summary("lat")
+    assert s["lat_count"] == 1
+    assert s["lat_mean"] == pytest.approx(7.3)
+    assert s["lat_max"] == pytest.approx(7.3)
+    # percentile clamps to observed min/max → single value reports exactly
+    for q in (0.5, 0.95, 0.99):
+        assert h.percentile(q) == pytest.approx(7.3)
+
+
+def test_histogram_percentiles_uniform_within_bucket_resolution():
+    h = Histogram(lo=1e-3, hi=1e5, per_decade=10)
+    for v in range(1, 1001):
+        h.observe(float(v))
+    assert h.count == 1000
+    assert h.mean == pytest.approx(500.5)
+    # log buckets at 10/decade have edge ratio 10^0.1 ≈ 1.26 — estimates
+    # must land within a bucket of the true percentile
+    assert h.percentile(0.50) == pytest.approx(500, rel=0.30)
+    assert h.percentile(0.99) == pytest.approx(990, rel=0.30)
+    assert (h.percentile(0.50) <= h.percentile(0.95)
+            <= h.percentile(0.99) <= h.vmax == 1000.0)
+
+
+def test_histogram_under_overflow_clamped():
+    h = Histogram(lo=1.0, hi=100.0, per_decade=5)
+    h.observe(1e-6)   # underflow bucket
+    h.observe(1e9)    # overflow bucket
+    assert h.count == 2
+    assert h.percentile(0.0) >= 1e-6
+    assert h.percentile(1.0) == pytest.approx(1e9)
+    h.observe(float("nan"))  # NaN is skipped, not propagated
+    assert h.count == 2
+
+
+def test_histogram_empty_and_reset():
+    h = Histogram()
+    assert h.summary("x") == {}
+    assert math.isnan(h.percentile(0.5))
+    h.observe(3.0)
+    assert h.summary("x") != {}
+    h.reset()
+    assert h.summary("x") == {}
+    assert h.count == 0
+
+
+def test_metrics_gauges_histograms_flatten(tmp_path):
+    jsonl = tmp_path / "m.jsonl"
+    m = Metrics(jsonl_path=str(jsonl))
+    m.gauge("queue/depth", 17)
+    m.observe("lat_ms", 4.0)
+    m.observe("lat_ms", 8.0)
+    tele = m.telemetry()
+    assert tele["queue/depth"] == 17.0
+    assert tele["lat_ms_count"] == 2
+    assert tele["lat_ms_max"] == pytest.approx(8.0)
+    m.log(1, **tele)
+    m.close()
+    (rec,) = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    assert rec["step"] == 1 and rec["queue/depth"] == 17.0
+
+
+# -- stats RPC round trip ---------------------------------------------------
+
+
+def test_stats_rpc_matches_actor_sent_counters():
+    from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
+    from distributed_deep_q_tpu.rpc.replay_server import (
+        ReplayFeedClient, ReplayFeedServer)
+
+    replay = ReplayMemory(256, (4,), np.float32)
+    server = ReplayFeedServer(replay)
+    host, port = server.address
+    client = ReplayFeedClient(host, port, actor_id=5)
+    try:
+        server.publish_params([np.ones(3, np.float32)])
+        version, weights = client.get_params()
+        assert weights is not None
+        client.call("heartbeat")
+
+        n = 16
+        pull_ms = np.asarray([1.5, 2.5], np.float32)
+        hb_ms = np.asarray([0.7], np.float32)
+        step_ms = np.asarray([0.1, 0.2, 0.3, 0.4], np.float32)
+        client.add_transitions(
+            obs=np.ones((n, 4), np.float32),
+            action=np.zeros(n, np.int32),
+            reward=np.ones(n, np.float32),
+            next_obs=np.ones((n, 4), np.float32),
+            discount=np.full(n, 0.99, np.float32),
+            episodes=1, ep_returns=np.asarray([12.0], np.float32),
+            tm_param_pull_ms=pull_ms, tm_heartbeat_rtt_ms=hb_ms,
+            tm_env_step_ms=step_ms)
+
+        stats = client.call("stats")
+        # server-side aggregates match exactly what this actor sent
+        assert stats["env_steps"] == n
+        assert stats["fleet/param_pull_ms_count"] == len(pull_ms)
+        assert stats["fleet/param_pull_ms_max"] == pytest.approx(2.5)
+        assert stats["fleet/heartbeat_rtt_ms_count"] == len(hb_ms)
+        assert stats["fleet/env_step_ms_count"] == len(step_ms)
+        assert stats["fleet/env_step_ms_p50"] <= 0.4
+        np.testing.assert_array_equal(stats["actor_ids"], [5])
+        np.testing.assert_array_equal(stats["actor_env_steps"], [n])
+        # per-method RPC accounting: latency + payload-size histograms
+        assert stats["rpc/add_transitions_calls"] == 1
+        assert stats["rpc/add_transitions_ms_count"] == 1
+        assert stats["rpc/add_transitions_ms_p99"] > 0
+        assert stats["rpc/add_transitions_bytes_max"] > n * 4 * 4 * 2
+        assert stats["rpc/heartbeat_calls"] == 1
+        assert stats["rpc/get_params_calls"] == 1
+        # queue gauges: replay depth + params-version lag (this actor has
+        # the latest θ, so the fleet lag is zero)
+        assert stats["queue/replay_size"] == len(replay) == n
+        assert stats["queue/params_version"] == version
+        assert stats["queue/params_version_lag"] == 0
+        assert stats["fleet/actors_seen"] == 1
+    finally:
+        client.close()
+        server.close()
+
+
+def test_stats_rpc_version_lag_counts_stale_actor():
+    from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
+    from distributed_deep_q_tpu.rpc.replay_server import (
+        ReplayFeedClient, ReplayFeedServer)
+
+    replay = ReplayMemory(64, (4,), np.float32)
+    server = ReplayFeedServer(replay)
+    host, port = server.address
+    client = ReplayFeedClient(host, port, actor_id=0)
+    try:
+        server.publish_params([np.zeros(2, np.float32)])
+        client.get_params()                              # pulled v1
+        server.publish_params([np.ones(2, np.float32)])  # now v2
+        stats = client.call("stats")
+        assert stats["queue/params_version"] == 2
+        assert stats["queue/params_version_lag"] == 1
+    finally:
+        client.close()
+        server.close()
+
+
+# -- telemetry_report -------------------------------------------------------
+
+
+def _synthetic_records():
+    return [
+        {"step": 100, "t": 1.0, "loss": 0.5, "grad_steps_per_s": 90.0,
+         "env_steps": 400, "time_sample_ms": 1.2, "time_sample_p50_ms": 1.0,
+         "time_sample_p99_ms": 3.0, "rpc/add_transitions_calls": 4,
+         "rpc/add_transitions_ms_p50": 0.4, "rpc/add_transitions_ms_p95": 0.9,
+         "queue/replay_size": 1000, "fleet/param_pull_ms_count": 3,
+         "fleet/param_pull_ms_p95": 2.0},
+        {"step": 200, "t": 2.0, "loss": 0.4, "grad_steps_per_s": 95.0,
+         "env_steps": 800, "queue/replay_size": 2000},
+    ]
+
+
+def test_report_renders_synthetic_jsonl(tmp_path):
+    jsonl = tmp_path / "run.jsonl"
+    jsonl.write_text("".join(json.dumps(r) + "\n"
+                             for r in _synthetic_records()))
+    records = load_records(str(jsonl))
+    assert validate_records(records) == []
+    report = render_report(records)
+    for needle in ("run overview", "step phases", "rpc methods",
+                   "add_transitions", "queue gauges", "queue/replay_size",
+                   "fleet", "anomalies (0)"):
+        assert needle in report, f"missing section {needle!r}\n{report}"
+
+
+def test_report_flags_anomalies(tmp_path):
+    recs = [{"step": 100, "t": 1.0}, {"step": 50, "t": 2.0},
+            {"step": 150, "t": 3.0, "loss": float("nan")}]
+    problems = validate_records(recs)
+    assert any("non-monotonic" in p for p in problems)
+    assert any("nan" in p for p in problems)
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"step": 1}\nnot json at all\n')
+    with pytest.raises(ValueError, match="bad.jsonl:2"):
+        load_records(str(bad))
+
+
+def test_report_cli_smoke(tmp_path):
+    jsonl = tmp_path / "run.jsonl"
+    jsonl.write_text("".join(json.dumps(r) + "\n"
+                             for r in _synthetic_records()))
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "telemetry_report.py"),
+         str(jsonl)], capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "run overview" in proc.stdout
+    # a missing file is a clean error, not a traceback
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "telemetry_report.py"),
+         str(tmp_path / "nope.jsonl")], capture_output=True, text=True,
+        timeout=60)
+    assert proc.returncode == 1 and "error:" in proc.stderr
+
+
+# -- tier-1 JSONL contract over a real run (satellite g) --------------------
+
+
+@pytest.mark.slow
+def test_distributed_run_jsonl_carries_rpc_and_fleet_telemetry(tmp_path):
+    """Full loopback topology: the learner's JSONL must carry the server's
+    per-method RPC latency histograms, the fleet counters the actors
+    flushed back, and the queue gauges — and the report must render it."""
+    from distributed_deep_q_tpu.actors.supervisor import train_distributed
+    from distributed_deep_q_tpu.config import cartpole_config
+
+    jsonl = tmp_path / "m.jsonl"
+    cfg = cartpole_config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.num_fake_devices = 2
+    cfg.train.total_steps = 150
+    cfg.replay.learn_start = 200
+    cfg.replay.batch_size = 32
+    cfg.actors.num_actors = 2
+    cfg.actors.send_batch = 16
+    cfg.actors.param_sync_period = 50
+    train_distributed(cfg, metrics=Metrics(jsonl_path=str(jsonl)),
+                      log_every=50)
+    records = load_records(str(jsonl))
+    assert validate_records(records) == []
+    merged: dict = {}
+    for r in records:
+        merged.update(r)
+    assert merged.get("rpc/add_transitions_calls", 0) > 0
+    assert merged.get("rpc/add_transitions_ms_p99", 0) > 0
+    assert merged.get("rpc/get_params_ms_count", 0) > 0
+    assert merged.get("fleet/param_pull_ms_count", 0) > 0
+    assert merged.get("fleet/env_step_ms_count", 0) > 0
+    assert merged.get("queue/replay_size", 0) > 0
+    assert "queue/params_version" in merged
+    assert merged.get("fleet/actors_seen", 0) == 2
+    report = render_report(records)
+    assert "rpc methods" in report and "fleet" in report
+
+
+def test_train_run_jsonl_valid_monotonic_with_telemetry(tmp_path):
+    from distributed_deep_q_tpu.config import cartpole_config
+    from distributed_deep_q_tpu.train import train_single_process
+
+    jsonl = tmp_path / "m.jsonl"
+    cfg = cartpole_config()
+    cfg.mesh.backend = "cpu"
+    cfg.train.total_steps = 700
+    cfg.train.train_every = 4
+    cfg.train.grad_steps_per_train = 1
+    cfg.train.eval_every = 0
+    cfg.replay.learn_start = 200
+    train_single_process(cfg, metrics=Metrics(jsonl_path=str(jsonl)),
+                         log_every=25)
+    records = load_records(str(jsonl))  # raises on any invalid-JSON line
+    assert records, "run produced no metrics records"
+    assert validate_records(records) == []  # monotonic steps, finite values
+    timed = [r for r in records if "time_sample_p99_ms" in r]
+    assert timed, "no streaming-histogram summary in the JSONL"
+    gauged = [r for r in records if "queue/replay_size" in r]
+    assert gauged, "no queue-depth gauge in the JSONL"
+    assert gauged[-1]["queue/replay_size"] > 0
+    render_report(records)  # must not raise on a real run's file
