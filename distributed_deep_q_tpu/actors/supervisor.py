@@ -179,6 +179,11 @@ class _ActorComms:
         # mid-episode, the exact event this thread exists to prevent. Retry
         # with exponential backoff while the loop is alive; only a
         # non-network error ends the thread, loudly.
+        #
+        # single-attempt sends: the beat's period IS its retry cadence —
+        # the resilient client's internal retry loop would hold the beat
+        # hostage for a full deadline and defeat the stall-budget gate
+        call = getattr(self._client, "call_once", self._client.call)
         backoff = period
         while not self._local_stop.wait(backoff):
             if (self._stall_budget
@@ -189,7 +194,7 @@ class _ActorComms:
                 #           supervisor respawns); resume if it recovers
             try:
                 t0 = time.perf_counter()
-                self._client.call("heartbeat")
+                call("heartbeat")
                 self._hb_ms.append(1e3 * (time.perf_counter() - t0))
                 self._hb_failures = 0
                 backoff = period
@@ -272,7 +277,8 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     from distributed_deep_q_tpu.actors.game import (
         FrameStacker, NStepAccumulator, StepLatencyEnv, make_env)
     from distributed_deep_q_tpu.models.qnet import QNet
-    from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedClient
+    from distributed_deep_q_tpu.rpc.resilience import (
+        ResilientReplayFeedClient, RetryPolicy)
 
     from distributed_deep_q_tpu.config import env_for_actor
     # global identity: actor_id is the LOCAL id (= per-host replay stream);
@@ -285,7 +291,17 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     cfg.net.num_actions = env.num_actions
     qnet = QNet(cfg.net, seed=cfg.train.seed,
                 obs_dim=int(np.prod(env.obs_shape)))
-    client = ReplayFeedClient(host, port, actor_id=actor_id)
+    # resilient stub: transient server outages (restart, network blip) are
+    # absorbed by retry/backoff with idempotent flush_seq stamping, so a
+    # learner restart means reconnect-and-resend, not an actor death —
+    # the restart storm the bare stub caused (every blip → fleet respawn)
+    client = ResilientReplayFeedClient.connect(
+        host, port, actor_id=actor_id,
+        policy=RetryPolicy(base_delay=cfg.actors.rpc_retry_base,
+                           max_delay=cfg.actors.rpc_retry_max,
+                           deadline=cfg.actors.rpc_retry_deadline),
+        should_abort=stop_event.is_set,
+        seed=cfg.train.seed + 31337 * (gid + 1))
     # announce a fresh writer on this stream id: the server seals the
     # previous writer's slot so no sampled window straddles a restart seam
     client.call("reset_stream")
@@ -514,15 +530,22 @@ class ActorSupervisor:
     """Spawns the actor fleet and restarts dead or silent actors."""
 
     def __init__(self, cfg: Config, host: str, port: int,
-                 heartbeat_timeout: float = 60.0):
+                 heartbeat_timeout: float = 60.0,
+                 spawn_grace: float = 120.0):
         self.cfg = cfg
         self.host, self.port = host, port
         self.heartbeat_timeout = heartbeat_timeout
+        # first-contact deadline for a fresh (re)spawn: generous — a child
+        # needs tens of seconds to import jax on a loaded 1-core host —
+        # but finite, so an actor that hangs BEFORE its first heartbeat
+        # (wedged env ctor, dead DNS) is still detected and replaced
+        self.spawn_grace = max(spawn_grace, heartbeat_timeout)
         self._ctx = mp.get_context("spawn")
         self.stop_event = self._ctx.Event()
         self.procs: dict[int, Any] = {}
         self.spawned_at: dict[int, float] = {}
         self.restarts = 0
+        self.kill_escalations = 0
         self._watch: threading.Thread | None = None
 
     def _spawn(self, i: int) -> None:
@@ -538,6 +561,29 @@ class ActorSupervisor:
         for i in range(self.cfg.actors.num_actors):
             self._spawn(i)
 
+    def _is_silent(self, now: float, last: float, spawned: float) -> bool:
+        """Liveness verdict for one actor. Contact since the last
+        (re)spawn → plain heartbeat timeout. No contact yet (stale stamps
+        from a previous incarnation count as none) → the spawn-grace
+        deadline, so an actor that hangs BEFORE its first heartbeat is
+        still replaced instead of living forever off a zero stamp."""
+        if last > spawned:
+            return now - last > self.heartbeat_timeout
+        return now - spawned > self.spawn_grace
+
+    def _reap(self, p) -> None:
+        """terminate → join → kill escalation. A child that shrugs off
+        SIGTERM (wedged in native code, masked handler) would otherwise
+        linger as a zombie holding its fds and replay stream; SIGKILL is
+        non-negotiable, and each escalation is counted for telemetry."""
+        if p.is_alive():
+            p.terminate()
+        p.join(timeout=5)
+        if p.is_alive():
+            p.kill()
+            p.join(timeout=5)
+            self.kill_escalations += 1
+
     def watch(self, last_seen: dict[int, float],
               poll_period: float = 2.0) -> None:
         """Background liveness loop: restart on process death or heartbeat
@@ -547,16 +593,11 @@ class ActorSupervisor:
                 now = time.monotonic()
                 for i, p in list(self.procs.items()):
                     dead = not p.is_alive()
-                    # silence is measured from the LATER of last contact and
-                    # last respawn, so a freshly-restarted child (which needs
-                    # seconds to import jax) isn't re-killed on stale stamps
-                    seen = max(last_seen.get(i, 0.0),
-                               self.spawned_at.get(i, 0.0))
-                    silent = seen > 0 and now - seen > self.heartbeat_timeout
+                    silent = self._is_silent(
+                        now, last_seen.get(i, 0.0),
+                        self.spawned_at.get(i, 0.0))
                     if dead or silent:
-                        if p.is_alive():
-                            p.terminate()
-                        p.join(timeout=5)
+                        self._reap(p)
                         self.restarts += 1
                         self._spawn(i)
                 time.sleep(poll_period)
@@ -570,12 +611,42 @@ class ActorSupervisor:
         for p in self.procs.values():
             p.join(timeout=timeout)
             if p.is_alive():
-                p.terminate()
+                self._reap(p)
 
 
 # ---------------------------------------------------------------------------
 # Distributed training loop (learner side)
 # ---------------------------------------------------------------------------
+
+
+def _bring_up_rpc_plane(cfg: Config, replay):
+    """Server + supervised fleet, with the fault-tolerance plumbing:
+    chaos spec exported for the spawned actors to inherit, warm boot from
+    ``train.server_snapshot_path`` (stable port when snapshotting — a
+    restarted learner must come back where the fleet expects it)."""
+    from distributed_deep_q_tpu.rpc import faultinject
+    from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedServer
+
+    if cfg.actors.chaos:
+        os.environ[faultinject.ENV_VAR] = cfg.actors.chaos
+    snap = cfg.train.server_snapshot_path
+    server = ReplayFeedServer(replay, host=cfg.actors.host,
+                              port=cfg.actors.port if snap else 0,
+                              snapshot_path=snap)
+    host, port = server.address
+    sup = ActorSupervisor(cfg, host, port)
+    sup.start()
+    sup.watch(server.last_seen)
+    return server, sup
+
+
+def _tear_down_rpc_plane(cfg: Config, server, sup) -> None:
+    sup.stop()
+    snap = cfg.train.server_snapshot_path
+    if snap:
+        server.shutdown(snap)  # quiesce + snapshot for the next warm boot
+    else:
+        server.close()
 
 
 def train_distributed(cfg: Config, metrics: Metrics | None = None,
@@ -604,7 +675,6 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     from distributed_deep_q_tpu.replay.multistream import MultiStreamFrameReplay
     from distributed_deep_q_tpu.replay.prioritized import maybe_prioritize
     from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
-    from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedServer
     from distributed_deep_q_tpu.solver import Solver
 
     metrics = metrics or Metrics()
@@ -653,13 +723,8 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                          seed=cfg.train.seed),
             replay_cfg, seed=cfg.train.seed)
 
-    server = ReplayFeedServer(replay, host=cfg.actors.host, port=0)
+    server, sup = _bring_up_rpc_plane(cfg, replay)
     server.publish_params(solver.get_weights())
-    host, port = server.address
-
-    sup = ActorSupervisor(cfg, host, port)
-    sup.start()
-    sup.watch(server.last_seen)
 
     fused_per = isinstance(replay, DevicePERFrameReplay)
     writeback = None
@@ -765,6 +830,8 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
 
             if ckpt and gstep % cfg.train.checkpoint_every == 0:
                 ckpt.save(solver.state, extra={"env_steps": server.env_steps})
+                if cfg.train.server_snapshot_path:
+                    server.snapshot(cfg.train.server_snapshot_path)
 
             if gstep % log_every == 0:
                 timer.measure_device(m["loss"])
@@ -776,6 +843,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                     "replay_size": len(replay),
                     "grad_steps_per_s": metrics.rate("grad_steps"),
                     "actor_restarts": sup.restarts,
+                    "actor_kill_escalations": sup.kill_escalations,
                 }
                 # one record carries the whole telemetry spine: per-phase
                 # times, per-RPC-method latency/size percentiles, queue
@@ -787,8 +855,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
         trace.close()
         if stager is not None:
             stager.close()
-        sup.stop()
-        server.close()
+        _tear_down_rpc_plane(cfg, server, sup)
 
     summary["final_return_avg100"] = server.mean_recent_return()
     if writeback:
@@ -797,6 +864,9 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     log_final_eval(solver, cfg, metrics, summary)
     summary["env_steps"] = server.env_steps
     summary["actor_restarts"] = sup.restarts
+    summary["actor_kill_escalations"] = sup.kill_escalations
+    summary["rpc_dispatch_errors"] = server.telemetry.dispatch_errors
+    summary["rpc_duplicate_flushes"] = server.telemetry.duplicate_flushes
     summary["solver"] = solver
     summary["replay"] = replay
     return summary
@@ -816,7 +886,6 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
     from distributed_deep_q_tpu.actors.game import make_env
     from distributed_deep_q_tpu.parallel.sequence_learner import SequenceSolver
     from distributed_deep_q_tpu.replay.sequence import SequenceReplay
-    from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedServer
     from distributed_deep_q_tpu.train import evaluate_recurrent
     from distributed_deep_q_tpu.utils.checkpoint import maybe_checkpointer
 
@@ -868,13 +937,8 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
             seed=cfg.train.seed, use_native=cfg.replay.use_native)
     learn_start_seqs = max(cfg.replay.learn_start // seq_len, 2)
 
-    server = ReplayFeedServer(replay, host=cfg.actors.host, port=0)
+    server, sup = _bring_up_rpc_plane(cfg, replay)
     server.publish_params(solver.get_weights())
-    host, port = server.address
-
-    sup = ActorSupervisor(cfg, host, port)
-    sup.start()
-    sup.watch(server.last_seen)
 
     ckpt = maybe_checkpointer(cfg.train)
     if ckpt and cfg.train.resume and ckpt.latest_step() is not None:
@@ -944,6 +1008,8 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
                                 1e3 * (time.perf_counter() - t0))
             if ckpt and gstep % cfg.train.checkpoint_every == 0:
                 ckpt.save(solver.state, extra={"env_steps": server.env_steps})
+                if cfg.train.server_snapshot_path:
+                    server.snapshot(cfg.train.server_snapshot_path)
             if gstep % log_every == 0:
                 summary = {
                     "loss": float(m["loss"]),
@@ -953,13 +1019,13 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
                     "replay_size": len(replay),
                     "grad_steps_per_s": metrics.rate("grad_steps"),
                     "actor_restarts": sup.restarts,
+                    "actor_kill_escalations": sup.kill_escalations,
                 }
                 metrics.log(gstep, **summary, **timer.summary(),
                             **server.telemetry_summary(),
                             **metrics.telemetry())
     finally:
-        sup.stop()
-        server.close()
+        _tear_down_rpc_plane(cfg, server, sup)
 
     summary["final_return_avg100"] = server.mean_recent_return()
     if writeback:
@@ -968,6 +1034,9 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
     log_final_eval(solver, cfg, metrics, summary, recurrent=True)
     summary["env_steps"] = server.env_steps
     summary["actor_restarts"] = sup.restarts
+    summary["actor_kill_escalations"] = sup.kill_escalations
+    summary["rpc_dispatch_errors"] = server.telemetry.dispatch_errors
+    summary["rpc_duplicate_flushes"] = server.telemetry.duplicate_flushes
     summary["solver"] = solver
     summary["replay"] = replay
     return summary

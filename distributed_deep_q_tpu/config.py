@@ -126,6 +126,12 @@ class TrainConfig:
     checkpoint_dir: str = ""
     checkpoint_every: int = 0  # grad steps between Orbax snapshots
     resume: bool = False       # restore newest snapshot before training
+    # learner-restart survival (distributed topology): when set, the
+    # ReplayFeed server binds actors.port (stable across restarts),
+    # snapshots replay + counters + the θ frame here (at checkpoint
+    # cadence and on exit), and warm-boots from it — a restarted learner
+    # resumes with its replay intact while actors simply reconnect
+    server_snapshot_path: str = ""
     # profiling (SURVEY §5.1): jax.profiler trace of a step window, and an
     # optional live profiler server port (0 = off)
     profile_dir: str = ""
@@ -188,6 +194,17 @@ class ActorConfig:
     env_stall_budget: float = 300.0
     # transitions per RPC AddTransitions message
     send_batch: int = 64
+    # RPC fault tolerance (rpc/resilience.py): exponential backoff between
+    # retried calls, capped per attempt, giving up after the deadline.
+    # Flushes are idempotent (flush_seq dedup on the server), so a retry
+    # after an ambiguous failure can never double-insert into replay
+    rpc_retry_base: float = 0.05
+    rpc_retry_max: float = 2.0
+    rpc_retry_deadline: float = 120.0
+    # chaos injection spec for the whole fleet (rpc/faultinject.py), e.g.
+    # "drop=0.02,delay=0.05:40,corrupt=0.01,seed=7"; propagated to actor
+    # processes via the DDQ_CHAOS env var. Empty = no faults
+    chaos: str = ""
     # replay-feed service address
     host: str = "127.0.0.1"
     port: int = 6379

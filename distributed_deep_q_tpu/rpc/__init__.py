@@ -1,0 +1,7 @@
+"""Actor↔learner RPC plane: wire protocol, ReplayFeed service, and the
+fault-tolerance layer (retry/backoff, idempotent flushes, chaos injection).
+"""
+
+from distributed_deep_q_tpu.rpc.protocol import ProtocolError  # noqa: F401
+from distributed_deep_q_tpu.rpc.resilience import (  # noqa: F401
+    ResilientReplayFeedClient, RetryPolicy, RPCError)

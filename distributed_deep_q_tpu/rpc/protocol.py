@@ -8,6 +8,8 @@ receive), no HTTP framing overhead, zero-copy numpy buffer sends.
 
 A message is a dict[str, ndarray | int | float | bool | str | None]:
 
+    u8  magic 0xD9   (frame-boundary guard: a desynced or corrupted stream
+    u8  version 2     is detected HERE, not as a reshape error in dispatch)
     u32 LE  total payload length
     u16 LE  item count
     per item:
@@ -15,6 +17,13 @@ A message is a dict[str, ndarray | int | float | bool | str | None]:
       u8 kind  (0 ndarray, 1 int64, 2 float64, 3 str, 4 bool, 5 none)
       ndarray: u8 dtypelen, dtype str, u8 ndim, u32×ndim shape, u64 nbytes, raw
       int64/float64: 8 bytes; str: u32 len + utf-8; bool: u8
+
+Every length/offset in ``decode`` is bounds-checked and the ndarray item
+enforces ``nbytes == prod(shape) * itemsize``, so truncated or bit-flipped
+frames raise ``ProtocolError`` instead of over-reading or mis-parsing into a
+valid-looking message. ``ProtocolError`` subclasses ``ValueError`` so
+existing transient-failure handlers (heartbeat backoff, client socket drop)
+classify it as a retryable stream fault.
 """
 
 from __future__ import annotations
@@ -27,7 +36,21 @@ import numpy as np
 
 MAX_MESSAGE = 1 << 30  # 1 GiB sanity cap
 
+MAGIC = 0xD9
+WIRE_VERSION = 2
+_HEADER = struct.Struct("<BBI")  # magic, version, payload length
+HEADER_SIZE = _HEADER.size
+
 _KIND_NDARRAY, _KIND_INT, _KIND_FLOAT, _KIND_STR, _KIND_BOOL, _KIND_NONE = range(6)
+
+# decode caps — far above anything the trainer ships, low enough that a
+# corrupted length field fails fast instead of allocating gigabytes
+_MAX_NDIM = 32
+_MAX_ITEMS = 4096
+
+
+class ProtocolError(ValueError):
+    """Malformed / truncated / desynced wire frame."""
 
 
 def encode(msg: dict[str, Any]) -> bytes:
@@ -38,7 +61,9 @@ def encode(msg: dict[str, Any]) -> bytes:
         parts.append(kb)
         if isinstance(val, np.ndarray):
             db = str(val.dtype).encode()
-            val = np.ascontiguousarray(val)
+            # asarray(order="C"), NOT ascontiguousarray: the latter
+            # promotes 0-d arrays to 1-d and the roundtrip loses the shape
+            val = np.asarray(val, order="C")
             parts.append(struct.pack("<BB", _KIND_NDARRAY, len(db)))
             parts.append(db)
             parts.append(struct.pack("<B", val.ndim))
@@ -60,53 +85,105 @@ def encode(msg: dict[str, Any]) -> bytes:
         else:
             raise TypeError(f"unsupported message value {key}={type(val)}")
     payload = b"".join(parts)
-    return struct.pack("<I", len(payload)) + payload
+    return _HEADER.pack(MAGIC, WIRE_VERSION, len(payload)) + payload
 
 
 def decode(payload: bytes) -> dict[str, Any]:
+    try:
+        return _decode(payload)
+    except ProtocolError:
+        raise
+    except (struct.error, UnicodeDecodeError, OverflowError, TypeError,
+            ValueError) as e:
+        # struct under-reads, bad utf-8, bogus dtype strings — anything a
+        # corrupted frame can trip inside the parser surfaces as ONE type
+        raise ProtocolError(f"malformed frame: {type(e).__name__}: {e}") \
+            from e
+
+
+def _need(payload: bytes, off: int, n: int, what: str) -> None:
+    if off + n > len(payload):
+        raise ProtocolError(
+            f"truncated frame: {what} needs {n} bytes at offset {off}, "
+            f"payload is {len(payload)}")
+
+
+def _decode(payload: bytes) -> dict[str, Any]:
     msg: dict[str, Any] = {}
+    _need(payload, 0, 2, "item count")
     (count,), off = struct.unpack_from("<H", payload), 2
+    if count > _MAX_ITEMS:
+        raise ProtocolError(f"item count {count} exceeds cap {_MAX_ITEMS}")
     for _ in range(count):
+        _need(payload, off, 2, "key length")
         (klen,) = struct.unpack_from("<H", payload, off)
         off += 2
+        _need(payload, off, klen, "key")
         key = payload[off:off + klen].decode()
         off += klen
+        _need(payload, off, 1, "kind")
         (kind,) = struct.unpack_from("<B", payload, off)
         off += 1
         if kind == _KIND_NDARRAY:
+            _need(payload, off, 1, "dtype length")
             (dlen,) = struct.unpack_from("<B", payload, off)
             off += 1
+            _need(payload, off, dlen, "dtype")
             dtype = np.dtype(payload[off:off + dlen].decode())
+            if dtype.hasobject:
+                raise ProtocolError(f"object dtype {dtype} not allowed")
             off += dlen
+            _need(payload, off, 1, "ndim")
             (ndim,) = struct.unpack_from("<B", payload, off)
             off += 1
+            if ndim > _MAX_NDIM:
+                raise ProtocolError(f"ndim {ndim} exceeds cap {_MAX_NDIM}")
+            _need(payload, off, 4 * ndim, "shape")
             shape = struct.unpack_from(f"<{ndim}I", payload, off)
             off += 4 * ndim
+            _need(payload, off, 8, "nbytes")
             (nbytes,) = struct.unpack_from("<Q", payload, off)
             off += 8
-            arr = np.frombuffer(payload, dtype, count=nbytes // dtype.itemsize,
+            # the frame-boundary integrity check: the byte count must agree
+            # with the declared geometry, or the stream is desynced/corrupt
+            expected = int(np.prod(shape, dtype=np.uint64)) * dtype.itemsize
+            if nbytes != expected:
+                raise ProtocolError(
+                    f"ndarray {key!r}: nbytes={nbytes} disagrees with "
+                    f"shape {tuple(shape)} × {dtype} (= {expected})")
+            _need(payload, off, nbytes, f"ndarray {key!r} data")
+            arr = np.frombuffer(payload, dtype, count=nbytes // dtype.itemsize
+                                if dtype.itemsize else 0,
                                 offset=off).reshape(shape)
             msg[key] = arr.copy()  # own the memory past the recv buffer
             off += nbytes
         elif kind == _KIND_INT:
+            _need(payload, off, 8, "int64")
             (msg[key],) = struct.unpack_from("<q", payload, off)
             off += 8
         elif kind == _KIND_FLOAT:
+            _need(payload, off, 8, "float64")
             (msg[key],) = struct.unpack_from("<d", payload, off)
             off += 8
         elif kind == _KIND_STR:
+            _need(payload, off, 4, "str length")
             (slen,) = struct.unpack_from("<I", payload, off)
             off += 4
+            _need(payload, off, slen, "str")
             msg[key] = payload[off:off + slen].decode()
             off += slen
         elif kind == _KIND_BOOL:
+            _need(payload, off, 1, "bool")
             (b,) = struct.unpack_from("<B", payload, off)
             msg[key] = bool(b)
             off += 1
         elif kind == _KIND_NONE:
             msg[key] = None
         else:
-            raise ValueError(f"bad message kind {kind}")
+            raise ProtocolError(f"bad message kind {kind}")
+    if off != len(payload):
+        raise ProtocolError(
+            f"{len(payload) - off} trailing bytes after {count} items")
     return msg
 
 
@@ -133,7 +210,14 @@ def recv_msg(sock: socket.socket) -> dict[str, Any]:
 def recv_msg_sized(sock: socket.socket) -> tuple[dict[str, Any], int]:
     """Receive one message and its wire payload size in bytes — the size
     feeds the server's per-method payload histograms without re-encoding."""
-    (length,) = struct.unpack("<I", _recv_exact(sock, 4))
+    magic, version, length = _HEADER.unpack(_recv_exact(sock, HEADER_SIZE))
+    if magic != MAGIC:
+        raise ProtocolError(
+            f"bad magic 0x{magic:02x} (expected 0x{MAGIC:02x}) — stream "
+            "desynced or peer speaks a different protocol")
+    if version != WIRE_VERSION:
+        raise ProtocolError(
+            f"wire version {version} (this side speaks {WIRE_VERSION})")
     if length > MAX_MESSAGE:
-        raise ValueError(f"message of {length} bytes exceeds cap")
+        raise ProtocolError(f"message of {length} bytes exceeds cap")
     return decode(_recv_exact(sock, length)), length

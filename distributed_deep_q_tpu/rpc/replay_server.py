@@ -22,6 +22,8 @@ learner's sampler) and a second guards the published parameter snapshot.
 
 from __future__ import annotations
 
+import logging
+import os
 import socket
 import threading
 import time
@@ -31,8 +33,11 @@ from typing import Any
 import numpy as np
 
 from distributed_deep_q_tpu.metrics import Histogram
+from distributed_deep_q_tpu.rpc import faultinject
 from distributed_deep_q_tpu.rpc.protocol import (
-    encode, recv_msg, recv_msg_sized, send_msg)
+    ProtocolError, encode, recv_msg, recv_msg_sized, send_msg)
+
+log = logging.getLogger(__name__)
 
 
 class ServerTelemetry:
@@ -63,6 +68,19 @@ class ServerTelemetry:
         self.fleet: dict[str, Histogram] = {}
         self.actor_env_steps: dict[int, int] = {}
         self.last_pulled_version: dict[int, int] = {}
+        # robustness gauges: dispatch failures answered with an error dict
+        # (instead of a dead serve thread) and retried flushes the seq
+        # dedup absorbed (each one is a prevented double-insert)
+        self.dispatch_errors = 0
+        self.duplicate_flushes = 0
+
+    def record_dispatch_error(self) -> None:
+        with self._lock:
+            self.dispatch_errors += 1
+
+    def record_duplicate_flush(self) -> None:
+        with self._lock:
+            self.duplicate_flushes += 1
 
     def record_call(self, method: str, ms: float, nbytes: int) -> None:
         with self._lock:
@@ -120,6 +138,8 @@ class ServerTelemetry:
             if self.last_pulled_version:
                 out["queue/params_version_lag"] = params_version - min(
                     self.last_pulled_version.values())
+            out["rpc/dispatch_errors"] = self.dispatch_errors
+            out["rpc/duplicate_flushes"] = self.duplicate_flushes
             return out
 
     def per_actor_env_steps(self) -> tuple[np.ndarray, np.ndarray]:
@@ -133,7 +153,12 @@ class ServerTelemetry:
 class ReplayFeedServer:
     """Threaded TCP server wrapping a replay buffer + parameter snapshot."""
 
-    def __init__(self, replay, host: str = "127.0.0.1", port: int = 0):
+    # rate limit for dispatch/frame error logging: chaos mode or a broken
+    # actor can fail thousands of times a second — log a sample, count all
+    ERR_LOG_PERIOD = 5.0
+
+    def __init__(self, replay, host: str = "127.0.0.1", port: int = 0,
+                 snapshot_path: str = ""):
         self.replay = replay
         self.telemetry = ServerTelemetry()
         # RLock: stats/mean_recent_return may be read under an already-held
@@ -147,6 +172,28 @@ class ReplayFeedServer:
         self.episodes = 0
         # bounded: only the recent tail is ever read (mean_recent_return)
         self.returns: deque[float] = deque(maxlen=1000)
+        # idempotent-flush dedup: highest flush_seq inserted per actor.
+        # Guarded by replay_lock — the seq check and the insert must be one
+        # atomic step or an ambiguous retry could still double-insert.
+        self._flush_seq: dict[int, int] = {}
+        self._err_log_at = 0.0
+        self._err_suppressed = 0
+        # live accepted connections, closed on shutdown so reconnecting
+        # actors fail fast into their retry policy instead of blocking on
+        # a half-dead socket
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        # dispatches between recv and reply; shutdown drains this to zero
+        # before snapshotting, so a request racing the shutdown is either
+        # fully in the snapshot (its lost-ack retry dedups) or never ran
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+
+        # warm boot BEFORE the listener opens: an actor reconnecting into a
+        # half-restored server could double-insert (dedup map not yet
+        # loaded) or pull a stale θ version
+        if snapshot_path:
+            self._restore(snapshot_path)
 
         self._sock = socket.create_server((host, port))
         self.address = self._sock.getsockname()
@@ -181,10 +228,102 @@ class ReplayFeedServer:
 
     def close(self) -> None:
         self._stop.set()
+        # shutdown() before close(): on Linux a blocked accept() is NOT
+        # woken by close() from another thread — the port would stay in
+        # LISTEN and a warm reboot on the same port would get EADDRINUSE
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        self._accept_thread.join(timeout=5)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    # -- restart survival ---------------------------------------------------
+    #
+    # A learner restart used to be fatal for the run: actors storm-restarted
+    # against a dead port and the replay warm-fill started from zero. The
+    # snapshot/warm-boot pair below makes the server a resumable process:
+    # ``shutdown(path)`` quiesces and dumps replay + counters + the θ frame;
+    # a new ``ReplayFeedServer(..., snapshot_path=path)`` on the SAME port
+    # comes back with its state intact, and actors simply reconnect through
+    # their retry policy — no restarts, no lost replay, no duplicate
+    # flushes (the dedup map rides in the snapshot).
+
+    def _snapshot_files(self, path: str) -> tuple[str, str]:
+        return f"{path}.server.npz", f"{path}.replay.npz"
+
+    def snapshot(self, path: str) -> None:
+        """Dump server state (+ replay when its tier supports persistence)
+        without stopping service — safe at checkpoint cadence."""
+        from distributed_deep_q_tpu.replay.persistence import save_replay
+
+        server_file, replay_file = self._snapshot_files(path)
+        with self.replay_lock:
+            with self._params_lock:
+                wire = self._params_wire
+                version = self._params_version
+            ids = sorted(self._flush_seq)
+            state: dict[str, Any] = {
+                "schema": 1,
+                "env_steps": self.env_steps,
+                "episodes": self.episodes,
+                "returns": np.asarray(list(self.returns), np.float64),
+                "flush_ids": np.asarray(ids, np.int64),
+                "flush_seqs": np.asarray(
+                    [self._flush_seq[i] for i in ids], np.int64),
+                "params_version": version,
+                "params_wire": np.frombuffer(wire, np.uint8)
+                if wire is not None else np.zeros(0, np.uint8),
+            }
+            np.savez(server_file, **state)
+            if self.replay is not None:
+                try:
+                    save_replay(self.replay, replay_file)
+                except TypeError as e:  # tier without persistence support
+                    log.warning("server snapshot: replay not persisted "
+                                "(%s); counters/params saved", e)
+
+    def shutdown(self, path: str, drain_timeout: float = 5.0) -> None:
+        """Graceful stop for a warm reboot: stop accepting, sever live
+        connections (clients retry into the reboot), drain in-flight
+        dispatches, snapshot state."""
+        self.close()
+        with self._inflight_cv:
+            self._inflight_cv.wait_for(lambda: self._inflight == 0,
+                                       timeout=drain_timeout)
+        self.snapshot(path)
+
+    def _restore(self, path: str) -> None:
+        from distributed_deep_q_tpu.replay.persistence import load_replay
+
+        server_file, replay_file = self._snapshot_files(path)
+        if not os.path.exists(server_file):
+            return  # cold boot: first run with snapshotting enabled
+        z = np.load(server_file, allow_pickle=False)
+        self.env_steps = int(z["env_steps"])
+        self.episodes = int(z["episodes"])
+        self.returns.extend(float(r) for r in z["returns"])
+        self._flush_seq = {int(i): int(s) for i, s in
+                           zip(z["flush_ids"], z["flush_seqs"])}
+        self._params_version = int(z["params_version"])
+        wire = z["params_wire"]
+        self._params_wire = wire.tobytes() if wire.size else None
+        if self.replay is not None and os.path.exists(replay_file):
+            load_replay(self.replay, replay_file)
+        log.info("warm boot from %s: env_steps=%d replay=%s θ-version=%d",
+                 path, self.env_steps,
+                 len(self.replay) if self.replay is not None else "-",
+                 self._params_version)
 
     # -- wire loop ----------------------------------------------------------
 
@@ -197,13 +336,54 @@ class ReplayFeedServer:
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
+    def _log_error(self, what: str, e: BaseException) -> None:
+        """Rate-limited error logging: one line per ERR_LOG_PERIOD with a
+        suppressed-count, so a chaos storm can't flood the log while a
+        serve-thread death still always leaves a trace."""
+        now = time.monotonic()
+        with self._conns_lock:
+            if now - self._err_log_at < self.ERR_LOG_PERIOD:
+                self._err_suppressed += 1
+                return
+            suppressed, self._err_suppressed = self._err_suppressed, 0
+            self._err_log_at = now
+        log.warning("replayfeed %s: %s: %s (+%d similar suppressed)",
+                    what, type(e).__name__, e, suppressed)
+
     def _serve(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn = faultinject.wrap(conn, side="server")
+        with self._conns_lock:
+            self._conns.add(conn)
         try:
             while not self._stop.is_set():
-                req, nbytes = recv_msg_sized(conn)
+                try:
+                    req, nbytes = recv_msg_sized(conn)
+                except ProtocolError as e:
+                    # desynced/corrupt stream: the frame boundary is gone,
+                    # so no error reply is possible — log, count, drop the
+                    # connection; the client reconnects on a clean stream
+                    self.telemetry.record_dispatch_error()
+                    self._log_error("bad frame", e)
+                    return
                 t0 = time.perf_counter()
-                resp = self._dispatch(req)
+                with self._inflight_cv:
+                    self._inflight += 1
+                try:
+                    try:
+                        resp = self._dispatch(req)
+                    except Exception as e:  # noqa: BLE001 — malformed
+                        # payloads (KeyError on a missing field, shape
+                        # mismatch, ...) must never kill the serve thread
+                        # silently: answer with an error dict so the
+                        # caller fails loudly
+                        self.telemetry.record_dispatch_error()
+                        self._log_error(f"dispatch {req.get('method')!r}", e)
+                        resp = {"error": f"{type(e).__name__}: {e}"}
+                finally:
+                    with self._inflight_cv:
+                        self._inflight -= 1
+                        self._inflight_cv.notify_all()
                 if isinstance(resp, (bytes, bytearray)):
                     conn.sendall(resp)  # pre-encoded frame (θ snapshot)
                 else:
@@ -216,6 +396,8 @@ class ReplayFeedServer:
         except (ConnectionError, OSError):
             pass  # actor went away; supervisor handles liveness
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             conn.close()
 
     def _dispatch(self, req: dict[str, Any]) -> dict[str, Any] | bytes:
@@ -226,6 +408,17 @@ class ReplayFeedServer:
 
         if method == "add_transitions":
             with self.replay_lock:
+                # idempotent-flush dedup: a resilient client resends a
+                # failed flush with the SAME flush_seq; if the first send
+                # actually landed (ack lost — the ambiguous failure), the
+                # stamp is already recorded and the retry must be a no-op
+                # or replay would hold duplicated transitions
+                seq = int(req.get("flush_seq", -1))
+                if seq >= 0 and actor_id >= 0 \
+                        and seq <= self._flush_seq.get(actor_id, -1):
+                    self.telemetry.record_duplicate_flush()
+                    return {"ok": True, "duplicate": True,
+                            "env_steps": self.env_steps}
                 if "init_c" in req:  # R2D2 sequence batch → SequenceReplay
                     # leading dim = sequence count; env-step accounting comes
                     # from the actor (overlapping windows would double-count)
@@ -253,6 +446,11 @@ class ReplayFeedServer:
                 for r in np.atleast_1d(req.get("ep_returns",
                                                np.zeros(0, np.float32))):
                     self.returns.append(float(r))
+                # stamp AFTER the insert succeeded: a failed insert must
+                # leave the seq unclaimed (the client is told via the
+                # error dict; only a clean landing may absorb its retries)
+                if seq >= 0 and actor_id >= 0:
+                    self._flush_seq[actor_id] = seq
             self.telemetry.on_transitions(actor_id, n, req)
             return {"ok": True, "env_steps": self.env_steps}
 
@@ -272,6 +470,11 @@ class ReplayFeedServer:
             with self.replay_lock:
                 if hasattr(self.replay, "reset_stream") and actor_id >= 0:
                     self.replay.reset_stream(actor_id)
+                # a fresh actor process restarts its flush_seq from 1; the
+                # dead predecessor can never retry again, so dropping its
+                # stamp here is what lets the replacement's flushes land
+                if actor_id >= 0:
+                    self._flush_seq.pop(actor_id, None)
             return {"ok": True}
 
         if method == "heartbeat":
@@ -346,9 +549,9 @@ class ReplayFeedClient:
             self._connect()
 
     def _connect(self) -> None:
-        self._sock = socket.create_connection(self._addr,
-                                              timeout=self._timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock = socket.create_connection(self._addr, timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = faultinject.wrap(sock, side="client")
 
     def call(self, method: str, **kwargs: Any) -> dict[str, Any]:
         with self._lock:

@@ -1,0 +1,186 @@
+"""Fault-tolerance layer for the actor↔learner RPC plane.
+
+The paper's parameter-server topology assumes every process survives the
+whole run; Podracer (arXiv:2104.06272) and IMPACT (arXiv:1912.00167) both
+make the opposite assumption — transient failures on the actor/learner
+boundary are normal and must be absorbed, not fatal. This module supplies
+the absorption:
+
+``RetryPolicy``
+    Exponential backoff with decorrelated jitter, a wall-clock deadline,
+    and a retryable-exception classification (connection loss, timeouts,
+    and ``ProtocolError`` stream desyncs — the client stub already drops
+    its socket on those, so the next attempt reconnects cleanly).
+
+``ResilientReplayFeedClient``
+    Wraps ``ReplayFeedClient`` so ``add_transitions`` / ``get_params`` /
+    ``reset_stream`` reconnect-and-resend instead of dying. Flushes are
+    made **idempotent**: every ``add_transitions`` is stamped with a
+    monotonically increasing ``flush_seq``, and a retry resends the SAME
+    seq — the server dedups ``(actor_id, flush_seq)``, so the ambiguous
+    failure mode (frame sent, ack lost) can never double-insert into
+    replay.
+
+Nothing here owns policy about *fatal* errors: once the deadline lapses
+the last exception propagates and the supervisor's respawn path takes
+over, exactly as before this layer existed.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from distributed_deep_q_tpu.rpc.protocol import ProtocolError
+
+log = logging.getLogger(__name__)
+
+# what a retry can fix: the peer vanished, the link hiccuped, or the stream
+# desynced (client dropped the socket; reconnect starts a clean frame).
+# socket.timeout is an OSError alias since 3.10 but spelled out for clarity.
+RETRYABLE = (ConnectionError, OSError, socket.timeout, ProtocolError)
+
+
+class RPCError(RuntimeError):
+    """The server answered with an application error — retrying won't help."""
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff + jitter with a total wall-clock deadline."""
+
+    base_delay: float = 0.05   # first backoff (seconds)
+    max_delay: float = 2.0     # per-attempt cap
+    multiplier: float = 2.0
+    jitter: float = 0.5        # each delay is scaled by U[1-jitter, 1]
+    deadline: float = 120.0    # give up after this many seconds total
+    retryable: tuple = RETRYABLE
+
+    def backoff(self, attempt: int, rng: np.random.Generator) -> float:
+        """Sleep length before retry ``attempt`` (0-based)."""
+        raw = min(self.base_delay * self.multiplier ** attempt,
+                  self.max_delay)
+        if self.jitter:
+            raw *= 1.0 - self.jitter * float(rng.random())
+        return raw
+
+    def run(self, fn: Callable[[], Any], *, rng: np.random.Generator,
+            should_abort: Callable[[], bool] | None = None,
+            on_retry: Callable[[int, BaseException], None] | None = None):
+        """Call ``fn`` until success, non-retryable error, abort, or
+        deadline; re-raises the last retryable error on give-up."""
+        start = time.monotonic()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except self.retryable as e:
+                if should_abort is not None and should_abort():
+                    raise
+                delay = self.backoff(attempt, rng)
+                if time.monotonic() + delay - start > self.deadline:
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                attempt += 1
+                time.sleep(delay)
+
+
+class ResilientReplayFeedClient:
+    """Retry/backoff + idempotent-flush wrapper around ``ReplayFeedClient``.
+
+    Drop-in for the raw stub in the actor loops: same ``call`` /
+    ``add_transitions`` / ``get_params`` / ``close`` surface. The one
+    deliberate behavioral difference: ``call_once`` exposes the raw
+    single-attempt path for callers that own their own retry cadence (the
+    heartbeat thread — its period IS its backoff, and retrying inside the
+    beat would defeat the stall-budget gate).
+    """
+
+    def __init__(self, client, policy: RetryPolicy | None = None,
+                 should_abort: Callable[[], bool] | None = None,
+                 seed: int | None = None):
+        self._client = client
+        self.policy = policy or RetryPolicy()
+        self._should_abort = should_abort
+        self._rng = np.random.default_rng(seed)
+        self._flush_seq = 0
+        self.retries = 0      # attempts beyond the first, all methods
+        self.gave_up = 0      # deadline exhaustions (error propagated)
+
+    @classmethod
+    def connect(cls, host: str, port: int, actor_id: int = 0,
+                policy: RetryPolicy | None = None, timeout: float = 30.0,
+                should_abort: Callable[[], bool] | None = None,
+                seed: int | None = None) -> "ResilientReplayFeedClient":
+        """Open a stub with retries on the INITIAL connection too — an
+        actor spawned while the learner is mid-restart must wait it out,
+        not die and feed the restart storm."""
+        from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedClient
+
+        policy = policy or RetryPolicy()
+        rng = np.random.default_rng(seed)
+        raw = policy.run(
+            lambda: ReplayFeedClient(host, port, actor_id=actor_id,
+                                     timeout=timeout),
+            rng=rng, should_abort=should_abort)
+        return cls(raw, policy, should_abort=should_abort, seed=seed)
+
+    @property
+    def actor_id(self) -> int:
+        return self._client.actor_id
+
+    def _on_retry(self, method: str) -> Callable[[int, BaseException], None]:
+        def cb(attempt: int, e: BaseException) -> None:
+            self.retries += 1
+            if attempt == 0:  # one line per outage, not per attempt
+                log.info("rpc %s failed (%s: %s); retrying with backoff",
+                         method, type(e).__name__, e)
+        return cb
+
+    def _run(self, method: str, fn: Callable[[], Any]):
+        try:
+            return self.policy.run(fn, rng=self._rng,
+                                   should_abort=self._should_abort,
+                                   on_retry=self._on_retry(method))
+        except self.policy.retryable:
+            self.gave_up += 1
+            raise
+
+    def call(self, method: str, **kwargs: Any) -> dict[str, Any]:
+        """Request/reply with retries. Safe for idempotent methods only —
+        ``add_transitions`` must go through its stamped wrapper below."""
+        return self._run(method,
+                         lambda: self._client.call(method, **kwargs))
+
+    def call_once(self, method: str, **kwargs: Any) -> dict[str, Any]:
+        """Single attempt, no retries (heartbeat thread's cadence)."""
+        return self._client.call(method, **kwargs)
+
+    def add_transitions(self, **batch: Any) -> dict[str, Any]:
+        """Idempotent flush: stamp a fresh ``flush_seq``, resend the SAME
+        stamp on every retry so the server can dedup ambiguous resends."""
+        self._flush_seq += 1
+        seq = self._flush_seq
+        resp = self._run(
+            "add_transitions",
+            lambda: self._client.call("add_transitions",
+                                      flush_seq=seq, **batch))
+        if resp.get("error"):
+            # the server rejected the payload (malformed batch, not a
+            # transport fault) — surface it loudly; retrying cannot help
+            raise RPCError(f"add_transitions rejected: {resp['error']}")
+        return resp
+
+    def get_params(self, have_version: int = -1):
+        """Returns (version, weights-or-None) like the raw stub."""
+        return self._run("get_params",
+                         lambda: self._client.get_params(have_version))
+
+    def close(self) -> None:
+        self._client.close()
