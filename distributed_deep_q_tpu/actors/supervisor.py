@@ -829,18 +829,20 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                                 1e3 * (time.perf_counter() - t0))
 
             if ckpt and gstep % cfg.train.checkpoint_every == 0:
-                ckpt.save(solver.state, extra={"env_steps": server.env_steps})
+                ckpt.save(solver.state,
+                          extra={"env_steps": server.counters()["env_steps"]})
                 if cfg.train.server_snapshot_path:
                     server.snapshot(cfg.train.server_snapshot_path)
 
             if gstep % log_every == 0:
                 timer.measure_device(m["loss"])
+                counts = server.counters()
                 summary = {
                     "loss": float(m["loss"]),
                     "q_mean": float(m["q_mean"]),
                     "return_avg100": server.mean_recent_return(),
-                    "env_steps": server.env_steps,
-                    "replay_size": len(replay),
+                    "env_steps": counts["env_steps"],
+                    "replay_size": counts["replay_size"],
                     "grad_steps_per_s": metrics.rate("grad_steps"),
                     "actor_restarts": sup.restarts,
                     "actor_kill_escalations": sup.kill_escalations,
@@ -862,11 +864,12 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
         writeback.drain()
     from distributed_deep_q_tpu.train import log_final_eval
     log_final_eval(solver, cfg, metrics, summary)
-    summary["env_steps"] = server.env_steps
+    summary["env_steps"] = server.counters()["env_steps"]
     summary["actor_restarts"] = sup.restarts
     summary["actor_kill_escalations"] = sup.kill_escalations
-    summary["rpc_dispatch_errors"] = server.telemetry.dispatch_errors
-    summary["rpc_duplicate_flushes"] = server.telemetry.duplicate_flushes
+    rpc = server.telemetry.robustness_counters()
+    summary["rpc_dispatch_errors"] = rpc["dispatch_errors"]
+    summary["rpc_duplicate_flushes"] = rpc["duplicate_flushes"]
     summary["solver"] = solver
     summary["replay"] = replay
     return summary
@@ -1007,16 +1010,18 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
                 metrics.observe("learner/publish_params_ms",
                                 1e3 * (time.perf_counter() - t0))
             if ckpt and gstep % cfg.train.checkpoint_every == 0:
-                ckpt.save(solver.state, extra={"env_steps": server.env_steps})
+                ckpt.save(solver.state,
+                          extra={"env_steps": server.counters()["env_steps"]})
                 if cfg.train.server_snapshot_path:
                     server.snapshot(cfg.train.server_snapshot_path)
             if gstep % log_every == 0:
+                counts = server.counters()
                 summary = {
                     "loss": float(m["loss"]),
                     "q_mean": float(m["q_mean"]),
                     "return_avg100": server.mean_recent_return(),
-                    "env_steps": server.env_steps,
-                    "replay_size": len(replay),
+                    "env_steps": counts["env_steps"],
+                    "replay_size": counts["replay_size"],
                     "grad_steps_per_s": metrics.rate("grad_steps"),
                     "actor_restarts": sup.restarts,
                     "actor_kill_escalations": sup.kill_escalations,
@@ -1032,11 +1037,12 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
         writeback.drain()
     from distributed_deep_q_tpu.train import log_final_eval
     log_final_eval(solver, cfg, metrics, summary, recurrent=True)
-    summary["env_steps"] = server.env_steps
+    summary["env_steps"] = server.counters()["env_steps"]
     summary["actor_restarts"] = sup.restarts
     summary["actor_kill_escalations"] = sup.kill_escalations
-    summary["rpc_dispatch_errors"] = server.telemetry.dispatch_errors
-    summary["rpc_duplicate_flushes"] = server.telemetry.duplicate_flushes
+    rpc = server.telemetry.robustness_counters()
+    summary["rpc_dispatch_errors"] = rpc["dispatch_errors"]
+    summary["rpc_duplicate_flushes"] = rpc["duplicate_flushes"]
     summary["solver"] = solver
     summary["replay"] = replay
     return summary

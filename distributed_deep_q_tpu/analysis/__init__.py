@@ -1,0 +1,42 @@
+"""Repo-native static analysis — machine-checked concurrency/JAX/RPC
+invariants.
+
+Four passes, one entry point:
+
+- ``locks``          — guarded-attribute lock discipline + static
+                       lock-order deadlock detection
+- ``purity``         — side effects inside jit/pmap/shard_map traces
+- ``protocol_drift`` — RPC client/server/wire skew
+- ``config_keys``    — ``cfg.<section>.<field>`` existence
+
+``run_all(repo_root)`` returns every finding; ``scripts/analysis_gate.py``
+is the CLI gate (exit non-zero on findings) and a tier-1 test keeps the
+shipped tree at zero findings. Suppress an individual line with
+``# ddq: allow(<rule>)`` (see ``core``).
+"""
+
+from __future__ import annotations
+
+import os
+
+from distributed_deep_q_tpu.analysis.core import Finding, Source
+from distributed_deep_q_tpu.analysis import (  # noqa: F401
+    config_keys, locks, protocol_drift, purity)
+
+__all__ = ["Finding", "Source", "run_all", "repo_root"]
+
+
+def repo_root() -> str:
+    """The directory containing the ``distributed_deep_q_tpu`` package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_all(root: str | None = None) -> list[Finding]:
+    root = root or repo_root()
+    findings: list[Finding] = []
+    findings += locks.check(root)
+    findings += purity.check(root)
+    findings += protocol_drift.check(root)
+    findings += config_keys.check(root)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

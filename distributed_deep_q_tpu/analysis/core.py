@@ -1,0 +1,129 @@
+"""Shared infrastructure for the repo-native static-analysis suite.
+
+The passes in this package (``locks``, ``purity``, ``protocol_drift``,
+``config_keys``) are AST checkers that understand *this* codebase's
+invariants — which attribute is guarded by which lock, which functions
+are jit-traced, which strings are RPC methods — rather than generic
+lint rules. This module holds what they share:
+
+- ``Finding`` — one (rule, file, line, message) result.
+- ``Source``  — a parsed file plus its ``# ddq: allow(<rule>)`` pragma
+  map; ``Source.finding`` is the ONLY way passes emit results, so
+  suppression is honored uniformly.
+- ``dotted`` / ``call_name`` — attribute-chain helpers ("self.replay_lock",
+  "np.random.normal") used by every pass.
+
+Suppression pragma: an end-of-line comment ``# ddq: allow(rule)`` (or
+``allow(rule-a, rule-b)`` / ``allow(*)``) silences findings of that rule
+on that line only. Rules match by exact name or by pass prefix — e.g.
+``allow(purity)`` covers ``purity.print``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+_PRAGMA = re.compile(r"#\s*ddq:\s*allow\(([^)]*)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer result, formatted ``path:line: [rule] message``."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Source:
+    """A parsed module + pragma map; findings route through here."""
+
+    path: str            # path as reported in findings (repo-relative)
+    text: str
+    tree: ast.Module
+    allow: dict[int, set[str]] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, abspath: str, relpath: str | None = None) -> "Source":
+        with open(abspath, encoding="utf-8") as f:
+            text = f.read()
+        return cls.parse(text, relpath or abspath)
+
+    @classmethod
+    def parse(cls, text: str, path: str) -> "Source":
+        tree = ast.parse(text, filename=path)
+        allow: dict[int, set[str]] = {}
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            m = _PRAGMA.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                allow[lineno] = rules
+        return cls(path=path, text=text, tree=tree, allow=allow)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        allowed = self.allow.get(line)
+        if not allowed:
+            return False
+        if "*" in allowed or rule in allowed:
+            return True
+        # pass-prefix match: allow(purity) covers purity.print etc.
+        return any(rule.startswith(a + ".") for a in allowed)
+
+    def finding(self, rule: str, node_or_line, message: str,
+                out: list[Finding]) -> None:
+        line = (node_or_line if isinstance(node_or_line, int)
+                else getattr(node_or_line, "lineno", 0))
+        if not self.suppressed(rule, line):
+            out.append(Finding(rule, self.path, line, message))
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a pure Name/Attribute chain, else None (calls,
+    subscripts, and anything computed break the chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    """Dotted name of a call's target, or None when computed."""
+    return dotted(call.func)
+
+
+def iter_py_files(root: str, subdirs: tuple[str, ...] = ()) -> list[str]:
+    """All ``.py`` files under ``root`` (or its listed subdirs), sorted.
+    Skips __pycache__ and hidden directories."""
+    bases = [os.path.join(root, d) for d in subdirs] if subdirs else [root]
+    out: list[str] = []
+    for base in bases:
+        if os.path.isfile(base) and base.endswith(".py"):
+            out.append(base)
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and not d.startswith(".")]
+            out.extend(os.path.join(dirpath, f) for f in filenames
+                       if f.endswith(".py"))
+    return sorted(out)
+
+
+def load_sources(root: str, paths: list[str]) -> list[Source]:
+    """Load files as Sources with repo-relative finding paths."""
+    srcs = []
+    for p in paths:
+        rel = os.path.relpath(p, root)
+        srcs.append(Source.load(p, rel))
+    return srcs

@@ -1,0 +1,260 @@
+"""Lock-discipline checker + static lock-order deadlock detection.
+
+The RPC plane's correctness rests on invariants like "the flush-seq
+dedup map is only touched under ``replay_lock``" — comments until now.
+This pass makes them machine-checked:
+
+- ``LockRegistry`` maps guarded attributes to the lock that owns them.
+  A guard names the attribute, the lock, the owning class (scopes
+  ``self.X`` checks), and the receiver expressions it applies to —
+  ``server.env_steps`` in the learner loop is checked, ``cfg.replay``
+  is not (same attribute name, unrelated object).
+- Any read/write of a guarded attribute outside a ``with <lock>:``
+  block on the SAME receiver is a ``locks.unguarded`` finding.
+  ``with`` nesting is lexical: a lambda inside ``with self._cv:`` (the
+  ``wait_for`` predicate) counts as held. Construction/restore methods
+  that run before any other thread exists are exempted by name.
+- Module-level globals guarded by a module lock (``native/__init__.py``
+  builds the ctypes lib under ``_lock``) use the per-file ``globals``
+  table.
+- A lock-ORDER graph is built from lexically nested ``with`` blocks
+  over known lock names; a cycle is a static deadlock →
+  ``locks.order-cycle``.
+
+Registering a new guarded field = one line in ``DEFAULT_REGISTRY``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from distributed_deep_q_tpu.analysis.core import (
+    Finding, Source, dotted, load_sources)
+
+RULE_UNGUARDED = "locks.unguarded"
+RULE_CYCLE = "locks.order-cycle"
+
+
+@dataclass(frozen=True)
+class Guard:
+    """One guarded attribute: which lock, whose attribute, which
+    receiver expressions the check applies to."""
+
+    lock: str                       # lock attribute name on the receiver
+    owner: str                      # class that owns the attribute
+    receivers: tuple[str, ...] = ("self",)  # dotted receivers to check
+
+
+@dataclass
+class LockRegistry:
+    attrs: dict[str, Guard] = field(default_factory=dict)
+    # file-suffix → {global name → module-level lock name}
+    globals: dict[str, dict[str, str]] = field(default_factory=dict)
+    # methods that run single-threaded (construction / warm boot)
+    unlocked_methods: frozenset = frozenset({"__init__", "_restore"})
+    # repo-relative files this pass walks
+    files: tuple[str, ...] = ()
+
+    def lock_names(self) -> set[str]:
+        names = {g.lock for g in self.attrs.values()}
+        for table in self.globals.values():
+            names.update(table.values())
+        return names
+
+
+DEFAULT_REGISTRY = LockRegistry(
+    attrs={
+        # ReplayFeedServer ingest state — counters, dedup map, and the
+        # buffer itself move together under replay_lock
+        "env_steps":        Guard("replay_lock", "ReplayFeedServer",
+                                  ("self", "server")),
+        "episodes":         Guard("replay_lock", "ReplayFeedServer",
+                                  ("self", "server")),
+        "returns":          Guard("replay_lock", "ReplayFeedServer",
+                                  ("self", "server")),
+        "replay":           Guard("replay_lock", "ReplayFeedServer",
+                                  ("self", "server")),
+        "_flush_seq":       Guard("replay_lock", "ReplayFeedServer",
+                                  ("self", "server")),
+        # published θ frame
+        "_params_wire":     Guard("_params_lock", "ReplayFeedServer"),
+        "_params_version":  Guard("_params_lock", "ReplayFeedServer"),
+        # live connection set + rate-limited error log state
+        "_conns":           Guard("_conns_lock", "ReplayFeedServer"),
+        "_err_log_at":      Guard("_conns_lock", "ReplayFeedServer"),
+        "_err_suppressed":  Guard("_conns_lock", "ReplayFeedServer"),
+        # in-flight dispatch count — the shutdown drain condition
+        "_inflight":        Guard("_inflight_cv", "ReplayFeedServer"),
+        # ServerTelemetry: every structure is touched from every serve
+        # thread; one lock guards them all
+        "method_calls":     Guard("_lock", "ServerTelemetry",
+                                  ("self", "server.telemetry")),
+        "method_lat":       Guard("_lock", "ServerTelemetry",
+                                  ("self", "server.telemetry")),
+        "method_bytes":     Guard("_lock", "ServerTelemetry",
+                                  ("self", "server.telemetry")),
+        "fleet":            Guard("_lock", "ServerTelemetry",
+                                  ("self", "server.telemetry")),
+        "actor_env_steps":  Guard("_lock", "ServerTelemetry",
+                                  ("self", "server.telemetry")),
+        "last_pulled_version": Guard("_lock", "ServerTelemetry",
+                                     ("self", "server.telemetry")),
+        "dispatch_errors":  Guard("_lock", "ServerTelemetry",
+                                  ("self", "server.telemetry")),
+        "duplicate_flushes": Guard("_lock", "ServerTelemetry",
+                                   ("self", "server.telemetry")),
+        # NOTE deliberately unregistered: ReplayFeedServer.last_seen is a
+        # GIL-atomic monotonic stamp dict (single-writer per key, reader
+        # tolerates staleness); DeviceStager._err is benign once-set.
+    },
+    globals={
+        "native/__init__.py": {"_lib": "_lock", "_tried": "_lock"},
+    },
+    files=(
+        "distributed_deep_q_tpu/rpc/replay_server.py",
+        "distributed_deep_q_tpu/actors/supervisor.py",
+        "distributed_deep_q_tpu/replay/staging.py",
+        "distributed_deep_q_tpu/native/__init__.py",
+    ),
+)
+
+
+class _Walker(ast.NodeVisitor):
+    """Lexical walk tracking held locks, enclosing class, enclosing
+    function names, and nested-with lock ordering."""
+
+    def __init__(self, src: Source, reg: LockRegistry,
+                 out: list[Finding],
+                 order_edges: dict[tuple[str, str], tuple[str, int]]):
+        self.src = src
+        self.reg = reg
+        self.out = out
+        self.order_edges = order_edges
+        self.held: list[str] = []        # dotted lock exprs, e.g. self._lock
+        self.classes: list[str] = []
+        self.funcs: list[str] = []
+        self.globals_table = next(
+            (t for suffix, t in reg.globals.items()
+             if src.path.replace(os.sep, "/").endswith(suffix)), {})
+        self._lock_names = reg.lock_names()
+
+    # -- scoping ----------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.classes.append(node.name)
+        self.generic_visit(node)
+        self.classes.pop()
+
+    def _visit_func(self, node) -> None:
+        self.funcs.append(getattr(node, "name", "<lambda>"))
+        self.generic_visit(node)
+        self.funcs.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_With(self, node: ast.With) -> None:
+        taken: list[str] = []
+        for item in node.items:
+            name = dotted(item.context_expr)
+            if name and name.rsplit(".", 1)[-1] in self._lock_names:
+                canon = name.rsplit(".", 1)[-1]
+                for h in self.held:
+                    prior = h.rsplit(".", 1)[-1]
+                    if prior != canon:
+                        self.order_edges.setdefault(
+                            (prior, canon), (self.src.path, item.context_expr.lineno))
+                self.held.append(name)
+                taken.append(name)
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in taken:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- checks -----------------------------------------------------------
+
+    def _exempt(self) -> bool:
+        # no threads exist before construction finishes; module-level
+        # statements run at import time, equally single-threaded
+        if not self.funcs:
+            return True
+        return any(f in self.reg.unlocked_methods for f in self.funcs)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        guard = self.reg.attrs.get(node.attr)
+        if guard is not None and not self._exempt():
+            recv = dotted(node.value)
+            applies = recv is not None and (
+                recv in guard.receivers if recv != "self"
+                else "self" in guard.receivers
+                and guard.owner in self.classes)
+            if applies and f"{recv}.{guard.lock}" not in self.held:
+                self.src.finding(
+                    RULE_UNGUARDED, node,
+                    f"access to {recv}.{node.attr} outside "
+                    f"'with {recv}.{guard.lock}:' "
+                    f"(guarded field of {guard.owner})", self.out)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        lock = self.globals_table.get(node.id)
+        if lock is not None and self.funcs and not self._exempt() \
+                and lock not in self.held:
+            self.src.finding(
+                RULE_UNGUARDED, node,
+                f"access to module global {node.id!r} outside "
+                f"'with {lock}:'", self.out)
+
+
+def _find_cycles(edges: dict[tuple[str, str], tuple[str, int]],
+                 out: list[Finding]) -> None:
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    color: dict[str, int] = {}
+    stack: list[str] = []
+
+    def dfs(n: str) -> None:
+        color[n] = 1
+        stack.append(n)
+        for m in sorted(graph[n]):
+            if color.get(m, 0) == 1:
+                cycle = stack[stack.index(m):] + [m]
+                path, line = edges.get((n, m)) or next(iter(edges.values()))
+                out.append(Finding(
+                    RULE_CYCLE, path, line,
+                    "lock-order cycle (potential deadlock): "
+                    + " -> ".join(cycle)))
+            elif color.get(m, 0) == 0:
+                dfs(m)
+        stack.pop()
+        color[n] = 2
+
+    for n in sorted(graph):
+        if color.get(n, 0) == 0:
+            dfs(n)
+
+
+def check_sources(sources: list[Source],
+                  registry: LockRegistry = DEFAULT_REGISTRY) -> list[Finding]:
+    out: list[Finding] = []
+    edges: dict[tuple[str, str], tuple[str, int]] = {}
+    for src in sources:
+        _Walker(src, registry, out, edges).visit(src.tree)
+    _find_cycles(edges, out)
+    return out
+
+
+def check(repo_root: str,
+          registry: LockRegistry = DEFAULT_REGISTRY) -> list[Finding]:
+    paths = [os.path.join(repo_root, f) for f in registry.files
+             if os.path.exists(os.path.join(repo_root, f))]
+    return check_sources(load_sources(repo_root, paths), registry)

@@ -1,0 +1,151 @@
+"""RPC protocol-drift detector — client/server/wire skew at lint time.
+
+Three cross-checks, so a renamed handler or a new client method shows
+up as a file:line finding instead of a live ``ProtocolError`` (or a
+silent ``{"error": "unknown method ..."}``) under traffic:
+
+- ``protocol.unhandled-method``: a ``.call("X")`` / ``.call_once("X")``
+  anywhere in the package, ``scripts/``, or ``tests/`` whose method
+  string has no ``method == "X"`` branch in
+  ``ReplayFeedServer._dispatch``.
+- ``protocol.orphan-handler``: a ``_dispatch`` branch whose method
+  string no caller ever emits — dead protocol surface that drifts
+  silently.
+- ``protocol.wire-skew``: a ``_KIND_*`` wire tag referenced by
+  ``encode`` but not ``_decode`` (or vice versa) in ``rpc/protocol.py``
+  — an encode/decode pairing break.
+
+Registering a new RPC method = adding the ``method == "X"`` branch and
+at least one literal call site; the pass needs no edits.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from distributed_deep_q_tpu.analysis.core import (
+    Finding, Source, call_name, iter_py_files, load_sources)
+
+SERVER_FILE = "distributed_deep_q_tpu/rpc/replay_server.py"
+PROTOCOL_FILE = "distributed_deep_q_tpu/rpc/protocol.py"
+EMITTER_DIRS = ("distributed_deep_q_tpu", "scripts", "tests")
+
+
+def dispatch_handlers(server_src: Source) -> dict[str, int]:
+    """Method strings handled by ``ReplayFeedServer._dispatch``:
+    string constants compared against the ``method`` variable."""
+    handlers: dict[str, int] = {}
+    dispatch: ast.FunctionDef | None = None
+    for node in ast.walk(server_src.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "ReplayFeedServer":
+            for item in ast.walk(node):
+                if isinstance(item, ast.FunctionDef) \
+                        and item.name == "_dispatch":
+                    dispatch = item
+    if dispatch is None:
+        return handlers
+    for node in ast.walk(dispatch):
+        if not isinstance(node, ast.Compare):
+            continue
+        operands = [node.left, *node.comparators]
+        if not any(isinstance(o, ast.Name) and o.id == "method"
+                   for o in operands):
+            continue
+        for o in operands:
+            if isinstance(o, ast.Constant) and isinstance(o.value, str):
+                handlers.setdefault(o.value, o.lineno)
+    return handlers
+
+
+def emitted_methods(sources: list[Source]) -> list[tuple[str, Source, int]]:
+    """Literal first arguments of ``.call(...)`` / ``.call_once(...)``
+    (also a bare ``call("X")`` — the heartbeat thread binds the method
+    to a local)."""
+    out: list[tuple[str, Source, int]] = []
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = call_name(node)
+            if name is None or name.rsplit(".", 1)[-1] not in (
+                    "call", "call_once"):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.append((arg.value, src, node.lineno))
+    return out
+
+
+def wire_kind_skew(proto_src: Source, out: list[Finding]) -> None:
+    defined: dict[str, int] = {}
+    for node in proto_src.tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets[0]
+            elts = targets.elts if isinstance(targets, ast.Tuple) \
+                else [targets]
+            for t in elts:
+                if isinstance(t, ast.Name) and t.id.startswith("_KIND_"):
+                    defined[t.id] = node.lineno
+
+    def used_in(fn_name: str) -> set[str]:
+        for node in proto_src.tree.body:
+            if isinstance(node, ast.FunctionDef) and node.name == fn_name:
+                return {n.id for n in ast.walk(node)
+                        if isinstance(n, ast.Name)
+                        and n.id.startswith("_KIND_")}
+        return set()
+
+    enc, dec = used_in("encode"), used_in("_decode")
+    for kind, line in sorted(defined.items()):
+        if kind in enc and kind not in dec:
+            proto_src.finding(
+                "protocol.wire-skew", line,
+                f"{kind} is encoded but never decoded — wire pairing "
+                "broken", out)
+        elif kind in dec and kind not in enc:
+            proto_src.finding(
+                "protocol.wire-skew", line,
+                f"{kind} is decoded but never encoded — wire pairing "
+                "broken", out)
+        elif kind not in enc and kind not in dec:
+            proto_src.finding(
+                "protocol.wire-skew", line,
+                f"{kind} is defined but used by neither encode nor "
+                "_decode", out)
+
+
+def check_sources(server_src: Source, proto_src: Source,
+                  emitter_sources: list[Source]) -> list[Finding]:
+    out: list[Finding] = []
+    handlers = dispatch_handlers(server_src)
+    emitted = emitted_methods(emitter_sources)
+    for method, src, line in emitted:
+        if method not in handlers:
+            src.finding(
+                "protocol.unhandled-method", line,
+                f"client emits RPC method {method!r} but "
+                "ReplayFeedServer._dispatch has no handler for it", out)
+    emitted_names = {m for m, _, _ in emitted}
+    for method, line in sorted(handlers.items()):
+        if method not in emitted_names:
+            server_src.finding(
+                "protocol.orphan-handler", line,
+                f"_dispatch handles {method!r} but no client, script, or "
+                "test ever emits it", out)
+    wire_kind_skew(proto_src, out)
+    return out
+
+
+def check(repo_root: str) -> list[Finding]:
+    server_src = Source.load(os.path.join(repo_root, SERVER_FILE),
+                             SERVER_FILE)
+    proto_src = Source.load(os.path.join(repo_root, PROTOCOL_FILE),
+                            PROTOCOL_FILE)
+    paths: list[str] = []
+    for d in EMITTER_DIRS:
+        full = os.path.join(repo_root, d)
+        if os.path.isdir(full):
+            paths.extend(iter_py_files(full))
+    return check_sources(server_src, proto_src,
+                         load_sources(repo_root, sorted(set(paths))))

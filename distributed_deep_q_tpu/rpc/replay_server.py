@@ -149,6 +149,13 @@ class ServerTelemetry:
                     np.asarray([self.actor_env_steps[i] for i in ids],
                                np.int64))
 
+    def robustness_counters(self) -> dict[str, int]:
+        """Locked read of the robustness gauges — summary/verdict paths
+        must not read them raw while serve threads increment."""
+        with self._lock:
+            return {"dispatch_errors": self.dispatch_errors,
+                    "duplicate_flushes": self.duplicate_flushes}
+
 
 class ReplayFeedServer:
     """Threaded TCP server wrapping a replay buffer + parameter snapshot."""
@@ -225,6 +232,18 @@ class ReplayFeedServer:
         with self.replay_lock:
             tail = list(self.returns)[-k:]
         return float(np.mean(tail)) if tail else float("nan")
+
+    def counters(self) -> dict[str, int]:
+        """Locked, mutually consistent read of the ingest counters for
+        the checkpoint/summary paths — a raw ``server.env_steps`` read
+        can interleave with an ``add_transitions`` mid-increment."""
+        with self.replay_lock:
+            return {
+                "env_steps": self.env_steps,
+                "episodes": self.episodes,
+                "replay_size": (len(self.replay)
+                                if self.replay is not None else 0),
+            }
 
     def close(self) -> None:
         self._stop.set()
@@ -451,8 +470,9 @@ class ReplayFeedServer:
                 # error dict; only a clean landing may absorb its retries)
                 if seq >= 0 and actor_id >= 0:
                     self._flush_seq[actor_id] = seq
+                total = self.env_steps
             self.telemetry.on_transitions(actor_id, n, req)
-            return {"ok": True, "env_steps": self.env_steps}
+            return {"ok": True, "env_steps": total}
 
         if method == "get_params":
             with self._params_lock:
@@ -511,8 +531,8 @@ class ReplayFeedServer:
         with self._params_lock:
             version = self._params_version
         out = self.telemetry.summary(params_version=version)
-        if self.replay is not None:
-            with self.replay_lock:
+        with self.replay_lock:
+            if self.replay is not None:
                 out["queue/replay_size"] = len(self.replay)
                 pending = getattr(self.replay, "pending_rows", None)
                 if pending is not None:

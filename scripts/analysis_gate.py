@@ -1,0 +1,42 @@
+"""Static-analysis gate — run every analyzer pass; exit non-zero on
+findings.
+
+    python scripts/analysis_gate.py [--root DIR]
+
+Runs the lock-discipline checker, JAX purity lint, RPC protocol-drift
+detector, and config-key checker over the tree and prints one
+``path:line: [rule] message`` line per finding. Exit status 0 = clean,
+1 = findings. Pure-CPU AST work, no jax import, sub-second — cheap
+enough for CI and for ``scripts/chaos_smoke.py``'s pre-flight check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: auto-detected)")
+    args = parser.parse_args(argv)
+
+    from distributed_deep_q_tpu.analysis import run_all
+
+    findings = run_all(args.root)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"analysis gate: FAILED — {len(findings)} finding(s)")
+        return 1
+    print("analysis gate: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,7 +83,8 @@ def run_chaos_smoke(num_actors: int = 4, flushes: int = 120, rows: int = 8,
 
     # kill + warm-reboot the learner once about half the traffic landed
     t_end = time.monotonic() + deadline / 2
-    while server.env_steps < total // 2 and time.monotonic() < t_end:
+    while server.counters()["env_steps"] < total // 2 \
+            and time.monotonic() < t_end:
         time.sleep(0.01)
     server.shutdown(snap)
     replay2 = ReplayMemory(max(2 * total, 1024), (2,), np.float32, seed=0)
@@ -94,6 +95,7 @@ def run_chaos_smoke(num_actors: int = 4, flushes: int = 120, rows: int = 8,
         t.join(timeout=deadline)
     hung = sum(t.is_alive() for t in threads)
     wall = time.perf_counter() - t0
+    rpc = server.telemetry.robustness_counters()
 
     expected = {a * 1_000_000 + f * 1_000 + r for a in range(num_actors)
                 for f in range(flushes) for r in range(rows)}
@@ -110,8 +112,8 @@ def run_chaos_smoke(num_actors: int = 4, flushes: int = 120, rows: int = 8,
         "chaos_spec": spec,
         "faults_fired": dict(sorted(plan.counters.items())),
         "client_retries": sum(retries),
-        "duplicate_flushes_absorbed": server.telemetry.duplicate_flushes,
-        "dispatch_errors": server.telemetry.dispatch_errors,
+        "duplicate_flushes_absorbed": rpc["duplicate_flushes"],
+        "dispatch_errors": rpc["dispatch_errors"],
         "hung_actors": hung,
         "errors": errors,
         "wall_s": round(wall, 2),
@@ -155,7 +157,23 @@ def run_train_chaos(argv: list[str]) -> dict:
     }
 
 
+def _require_clean_gate() -> None:
+    """Chaos results must never be reported for code with known race
+    findings — refuse to run unless the static-analysis gate is clean."""
+    from distributed_deep_q_tpu.analysis import run_all
+
+    findings = run_all()
+    if findings:
+        for f in findings:
+            print(f, file=sys.stderr)
+        print(f"chaos_smoke: REFUSING to run — analysis gate failed with "
+              f"{len(findings)} finding(s); fix or suppress them first "
+              "(python scripts/analysis_gate.py)", file=sys.stderr)
+        sys.exit(2)
+
+
 if __name__ == "__main__":
+    _require_clean_gate()
     args = sys.argv[1:]
     if args and args[0] == "train":
         print(json.dumps(run_train_chaos(args[1:]), default=str))

@@ -1,0 +1,416 @@
+"""Static-analysis suite tests — each rule catches its synthetic bad
+module, suppression works (and unsuppressed findings still fail), and
+the self-hosting gate holds the real tree at zero findings."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from distributed_deep_q_tpu.analysis import repo_root, run_all
+from distributed_deep_q_tpu.analysis import (
+    config_keys, locks, protocol_drift, purity)
+from distributed_deep_q_tpu.analysis.core import Source
+
+
+def src(text: str, path: str = "synthetic.py") -> Source:
+    return Source.parse(textwrap.dedent(text), path)
+
+
+def rules(findings) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# lock discipline
+# ---------------------------------------------------------------------------
+
+LOCK_REG = locks.LockRegistry(
+    attrs={"count": locks.Guard("lock", "Server", ("self", "server"))},
+    globals={"mod.py": {"g_state": "g_lock"}},
+)
+
+
+def test_locks_unguarded_access_caught():
+    findings = locks.check_sources([src("""
+        class Server:
+            def bump(self):
+                self.count += 1
+    """)], LOCK_REG)
+    assert rules(findings) == {locks.RULE_UNGUARDED}
+    assert findings[0].line == 4
+
+
+def test_locks_guarded_access_clean():
+    findings = locks.check_sources([src("""
+        class Server:
+            def bump(self):
+                with self.lock:
+                    self.count += 1
+    """)], LOCK_REG)
+    assert findings == []
+
+
+def test_locks_lambda_inside_with_counts_as_held():
+    findings = locks.check_sources([src("""
+        class Server:
+            def drain(self):
+                with self.lock:
+                    wait(lambda: self.count == 0)
+    """)], LOCK_REG)
+    assert findings == []
+
+
+def test_locks_init_exempt_but_other_methods_not():
+    findings = locks.check_sources([src("""
+        class Server:
+            def __init__(self):
+                self.count = 0
+            def peek(self):
+                return self.count
+    """)], LOCK_REG)
+    assert [f.line for f in findings] == [6]
+
+
+def test_locks_foreign_receiver_checked_unrelated_skipped():
+    findings = locks.check_sources([src("""
+        def loop(server, cfg):
+            x = server.count          # guarded receiver: finding
+            y = cfg.count             # unrelated object: skipped
+            with server.lock:
+                z = server.count      # held: clean
+    """)], LOCK_REG)
+    assert [f.line for f in findings] == [3]
+
+
+def test_locks_module_global_guard():
+    findings = locks.check_sources([src("""
+        import threading
+        g_lock = threading.Lock()
+        g_state = None
+
+        def bad():
+            global g_state
+            g_state = 1
+
+        def good():
+            global g_state
+            with g_lock:
+                g_state = 2
+    """, path="mod.py")], LOCK_REG)
+    assert rules(findings) == {locks.RULE_UNGUARDED}
+    assert all(f.line in (7, 8) for f in findings)
+
+
+def test_locks_order_cycle_detected():
+    findings = locks.check_sources([src("""
+        class A:
+            def one(self):
+                with self.lock:
+                    with self.other:
+                        pass
+            def two(self):
+                with self.other:
+                    with self.lock:
+                        pass
+    """)], locks.LockRegistry(attrs={
+        "x": locks.Guard("lock", "A"), "y": locks.Guard("other", "A")}))
+    assert rules(findings) == {locks.RULE_CYCLE}
+
+
+def test_locks_consistent_order_no_cycle():
+    findings = locks.check_sources([src("""
+        class A:
+            def one(self):
+                with self.lock:
+                    with self.other:
+                        pass
+            def two(self):
+                with self.lock:
+                    with self.other:
+                        pass
+    """)], locks.LockRegistry(attrs={
+        "x": locks.Guard("lock", "A"), "y": locks.Guard("other", "A")}))
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# purity
+# ---------------------------------------------------------------------------
+
+
+def test_purity_impure_jit_body_caught():
+    findings = purity.check_sources([src("""
+        import jax, time, numpy as np
+
+        stats = {}
+
+        def step(state, batch):
+            print("tracing")
+            t = time.time()
+            host = np.asarray(batch)
+            stats["calls"] = t          # captured-module-state mutation
+            return state
+
+        train = jax.jit(step)
+    """)])
+    assert rules(findings) == {"purity.print", "purity.time",
+                               "purity.host-sync", "purity.captured-write"}
+
+
+def test_purity_non_jitted_function_not_flagged():
+    findings = purity.check_sources([src("""
+        import numpy as np
+
+        def feed(batch):
+            print("host side")
+            return np.asarray(batch)
+    """)])
+    assert findings == []
+
+
+def test_purity_callee_expansion_and_partial_wrapper():
+    findings = purity.check_sources([src("""
+        import functools, jax
+        import numpy as np
+
+        def helper(x):
+            return x.item()
+
+        def kernel(ref, o_ref):
+            o_ref[0] = helper(ref[0])
+
+        jax.experimental.pallas.pallas_call(
+            functools.partial(kernel, 3))
+    """)])
+    assert rules(findings) == {"purity.host-sync"}
+
+
+def test_purity_local_alias_resolves_to_kernel():
+    findings = purity.check_sources([src("""
+        import functools, random
+
+        def build(pl):
+            def kernel(ref):
+                ref[0] = random.random()
+            k = functools.partial(kernel, 1)
+            return pl.pallas_call(k)
+    """)])
+    assert "purity.host-rng" in rules(findings)
+
+
+def test_purity_rng_and_item_decorated():
+    findings = purity.check_sources([src("""
+        import jax, numpy as np
+
+        @jax.jit
+        def step(x):
+            noise = np.random.normal()
+            return (x + noise).item()
+    """)])
+    assert rules(findings) == {"purity.host-rng", "purity.host-sync"}
+
+
+def test_purity_local_writes_allowed():
+    findings = purity.check_sources([src("""
+        import jax
+
+        @jax.jit
+        def step(batch):
+            batch = dict(batch)
+            batch["x"] = 1
+            acc = {}
+            acc["y"] = 2
+            return batch, acc
+    """)])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# protocol drift
+# ---------------------------------------------------------------------------
+
+SERVER_SRC = """
+    class ReplayFeedServer:
+        def _dispatch(self, req):
+            method = req.get("method")
+            if method == "ping":
+                return {"ok": True}
+            if method == "orphaned":
+                return {"ok": True}
+"""
+
+PROTO_SRC = """
+    _KIND_A, _KIND_B = range(2)
+
+    def encode(msg):
+        return [_KIND_A, _KIND_B]
+
+    def _decode(payload):
+        return [_KIND_A]
+"""
+
+
+def test_protocol_orphan_and_unhandled_and_wire_skew():
+    findings = protocol_drift.check_sources(
+        src(SERVER_SRC, "server.py"), src(PROTO_SRC, "proto.py"),
+        [src("""
+            def go(client):
+                client.call("ping")
+                client.call("renamed_method")
+        """, "client.py")])
+    by_rule = {f.rule: f for f in findings}
+    assert by_rule["protocol.unhandled-method"].path == "client.py"
+    assert "renamed_method" in by_rule["protocol.unhandled-method"].message
+    assert "orphaned" in by_rule["protocol.orphan-handler"].message
+    assert "_KIND_B" in by_rule["protocol.wire-skew"].message
+
+
+def test_protocol_clean_when_paired():
+    findings = protocol_drift.check_sources(
+        src(SERVER_SRC, "server.py"),
+        src("""
+            _KIND_A = 0
+            def encode(m):
+                return _KIND_A
+            def _decode(p):
+                return _KIND_A
+        """, "proto.py"),
+        [src("""
+            def go(c):
+                c.call("ping")
+                c.call_once("orphaned")
+        """, "client.py")])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# config keys
+# ---------------------------------------------------------------------------
+
+SCHEMA = {"train": {"lr", "total_steps"}, "net": {"kind"}}
+
+
+def test_config_unknown_key_caught():
+    findings = config_keys.check_sources(SCHEMA, [src("""
+        def run(cfg):
+            cfg.train.lr = 1e-3
+            return cfg.train.total_stepz
+    """)])
+    assert rules(findings) == {config_keys.RULE}
+    assert "train.total_stepz" in findings[0].message
+
+
+def test_config_non_config_roots_skipped():
+    findings = config_keys.check_sources(SCHEMA, [src("""
+        def run(solver, cfg):
+            solver.train.whatever()   # root not a config expr
+            cfg.optimizer.zero_grad() # unknown section: skipped
+            return cfg.net.kind
+    """)])
+    assert findings == []
+
+
+def test_config_schema_parsed_from_real_config():
+    cfg_src = Source.load(
+        os.path.join(repo_root(), config_keys.CONFIG_FILE),
+        config_keys.CONFIG_FILE)
+    schema = config_keys.config_schema(cfg_src)
+    assert set(schema) == {"net", "replay", "train", "env", "actors", "mesh"}
+    assert "num_actions" in schema["net"]
+    assert "server_snapshot_path" in schema["train"]
+
+
+# ---------------------------------------------------------------------------
+# suppression pragma
+# ---------------------------------------------------------------------------
+
+
+def test_pragma_suppresses_exact_rule():
+    findings = locks.check_sources([src("""
+        class Server:
+            def peek(self):
+                return self.count  # ddq: allow(locks.unguarded)
+    """)], LOCK_REG)
+    assert findings == []
+
+
+def test_pragma_pass_prefix_and_star():
+    base = """
+        class Server:
+            def peek(self):
+                return self.count  {pragma}
+    """
+    for pragma in ("# ddq: allow(locks)", "# ddq: allow(*)"):
+        findings = locks.check_sources(
+            [src(base.format(pragma=pragma))], LOCK_REG)
+        assert findings == [], pragma
+
+
+def test_unsuppressed_finding_still_fails():
+    """The pragma is line- and rule-scoped: a wrong rule name or a
+    different line must NOT silence the finding."""
+    findings = locks.check_sources([src("""
+        class Server:  # ddq: allow(locks.unguarded)
+            def peek(self):
+                return self.count  # ddq: allow(purity.print)
+    """)], LOCK_REG)
+    assert rules(findings) == {locks.RULE_UNGUARDED}
+
+
+# ---------------------------------------------------------------------------
+# self-hosting gate
+# ---------------------------------------------------------------------------
+
+
+def test_self_hosting_zero_findings():
+    """The shipped tree passes every analyzer — the gate ratchets from
+    here: any new unguarded access / impure jit body / protocol or
+    config drift fails tier-1."""
+    findings = run_all()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_gate_cli_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo_root(), "scripts",
+                                      "analysis_gate.py")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_gate_cli_fails_on_broken_invariant(tmp_path):
+    """Deliberately breaking a lock invariant in a COPY of the tree
+    makes the gate exit non-zero with a file:line finding."""
+    import shutil
+    root = repo_root()
+    for d in ("distributed_deep_q_tpu", "scripts", "tests"):
+        shutil.copytree(os.path.join(root, d), tmp_path / d,
+                        ignore=shutil.ignore_patterns("__pycache__"))
+    target = tmp_path / "distributed_deep_q_tpu/rpc/replay_server.py"
+    text = target.read_text().replace(
+        'if method == "reset_stream":', 'if method == "reset_streamz":')
+    target.write_text(text)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "analysis_gate.py"),
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "protocol." in proc.stdout
+    # findings carry file:line
+    assert any(line.split(":")[1].isdigit()
+               for line in proc.stdout.splitlines() if ":" in line)
+
+
+def test_chaos_smoke_preflight_passes_on_clean_tree():
+    sys.path.insert(0, os.path.join(repo_root(), "scripts"))
+    try:
+        import chaos_smoke
+        chaos_smoke._require_clean_gate()  # must not SystemExit
+    finally:
+        sys.path.pop(0)
