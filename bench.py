@@ -248,6 +248,52 @@ def fused_train_flops(solver, replay, chain: int) -> float | None:
         return None
 
 
+def fused_train_census(solver, replay, chain) -> dict | None:
+    """Scheduled-op census of the FUSED train program's per-grad-step scan
+    body — the quantity the op-count ratchet budgets (PERF.md §3,
+    tests/test_op_count.py). Emitted with every bench run so an op-count
+    regression shows up in the BENCH json next to the throughput it
+    taxes."""
+    try:
+        import jax
+
+        from distributed_deep_q_tpu.profiling import hlo_scan_body_census
+
+        sample, train = solver.learner._device_per_steps[
+            (solver._dp_spec, chain)]
+        cursors, sizes = replay.device_inputs()
+        betas = np.full(chain, 0.5, np.float32)
+        keys = np.zeros((replay.num_shards, chain, 2), np.uint32)
+        rows = replay.dstate
+        metas, win, idx = jax.eval_shape(
+            sample, keys, rows.frames, rows.action, rows.reward,
+            rows.done, rows.boundary, rows.prio, np.asarray(cursors),
+            np.asarray(sizes), betas)
+        text = train.lower(solver.state, metas, win, idx, rows.prio,
+                           rows.maxp).compile().as_text()
+        return hlo_scan_body_census(text)
+    except Exception:
+        return None
+
+
+def r2d2_train_census(solver, batch) -> dict | None:
+    """Scheduled-op census of the compiled R2D2 host-batch train program
+    (whole module — the program is unchained, so the whole census IS the
+    per-step count)."""
+    try:
+        from distributed_deep_q_tpu.parallel.multihost import global_batch
+        from distributed_deep_q_tpu.profiling import hlo_op_census
+
+        clean = solver._strip(batch)
+        text = solver.learner._train_step.lower(
+            solver.state,
+            global_batch(solver.learner._batch_sharding, clean),
+        ).compile().as_text()
+        return hlo_op_census(text)
+    except Exception:
+        return None
+
+
 def build(cfg_mod, *, capacity: int, batch: int, prioritized: bool,
           pallas: bool, num_streams: int = 1, prefill: int = 40_000,
           seed: int = 0, device_per: bool = False):
@@ -586,6 +632,11 @@ def bench_r2d2(cfg_mod, on_cpu: bool, out: dict) -> None:
         return solver.train_step(b)
 
     out["r2d2_host_steps_per_s"] = round(time_loop(host_step, iters_host), 2)
+    census = r2d2_train_census(solver, host.sample(batch))
+    if census:
+        out["r2d2_train_fusions"] = census["fusion"]
+        out["r2d2_train_convs"] = census["convolution"]
+        out["r2d2_train_copies"] = census["copy"]
     del host
 
     dev = DeviceSequenceReplay(n_seqs, seq_len, obs_shape, solver.mesh,
@@ -736,6 +787,12 @@ def main() -> None:
     out["batch32_spread"] = round((max(rates32) - min(rates32)) / b32, 4)
     out["batch32_chain_k"] = b32_chain
     out["batch32_per"] = "device_fused"
+    census = fused_train_census(solver, replay, b32_chain)
+    if census:
+        # op-count ratchet telemetry (PERF §3): the b32 chain-body census
+        out["train_fusions"] = census["fusion"]
+        out["train_convs"] = census["convolution"]
+        out["train_copies"] = census["copy"]
     rates32u = time_variant(solver, replay, 32, iters, warmup, chain=1)
     out["batch32_single_dispatch_steps_per_s"] = \
         round(float(np.median(rates32u)), 2)

@@ -10,8 +10,10 @@ jit roots and walks their call graphs statically:
 
 - Roots: arguments of ``jit``/``pmap``/``shard_map``/``pallas_call``
   calls (by name, lambda, or ``functools.partial(f, ...)`` — including
-  a local alias ``k = partial(f, ...); pallas_call(k, ...)``) and
-  functions decorated with them.
+  a local alias ``k = partial(f, ...); pallas_call(k, ...)`` and a
+  static gate ``train_fn = plane_fn if use_plane else tree_fn``, whose
+  BOTH branches are roots — the stacked/donated step builders pick
+  their traced body this way) and functions decorated with them.
 - Expansion: callees by bare name or ``self.<name>`` resolve within the
   same module; bare names also resolve to uniquely-named top-level
   functions elsewhere in the scanned set (the ``ops.losses`` functions
@@ -80,18 +82,31 @@ class _ModuleIndex:
                 self.top_level.add(node.name)
 
 
+def _alias_targets(value: ast.AST) -> list[str]:
+    """Function names an assignment RHS can resolve to: a bare ``f``, a
+    ``partial(f, ...)``, or a static gate picking between builders —
+    ``train_fn = plane_train_fn if use_plane else tree_train_fn`` (the
+    stacked/donated step builders select their traced body this way);
+    both branches are roots."""
+    value = _unwrap_partial(value)
+    if isinstance(value, ast.IfExp):
+        return _alias_targets(value.body) + _alias_targets(value.orelse)
+    if isinstance(value, ast.Name):
+        return [value.id]
+    return []
+
+
 def _local_aliases(tree: ast.AST) -> dict[str, list[str]]:
-    """``x = f`` / ``x = partial(f, ...)`` anywhere in the module →
-    {x: [f, ...]} for resolving wrapper arguments passed by name. The
-    same alias name in different scopes (``kernel = partial(...)`` in
-    two builders) keeps every target."""
+    """``x = f`` / ``x = partial(f, ...)`` / ``x = f if gate else g``
+    anywhere in the module → {x: [f, ...]} for resolving wrapper
+    arguments passed by name. The same alias name in different scopes
+    (``kernel = partial(...)`` in two builders) keeps every target."""
     out: dict[str, list[str]] = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name):
-            value = _unwrap_partial(node.value)
-            if isinstance(value, ast.Name):
-                out.setdefault(node.targets[0].id, []).append(value.id)
+            for name in _alias_targets(node.value):
+                out.setdefault(node.targets[0].id, []).append(name)
     return out
 
 
@@ -110,6 +125,9 @@ def _collect_roots(idx: _ModuleIndex) -> list[_FuncNode]:
         arg = _unwrap_partial(arg)
         if isinstance(arg, ast.Lambda):
             add(arg)
+        elif isinstance(arg, ast.IfExp):
+            resolve(arg.body)
+            resolve(arg.orelse)
         elif isinstance(arg, ast.Name):
             for name in aliases.get(arg.id, [arg.id]):
                 for fn in idx.by_name.get(name, []):

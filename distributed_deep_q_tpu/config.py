@@ -120,6 +120,14 @@ class TrainConfig:
     # the (zero-cotangent) backward — wins when the step is weight-read
     # bound (small batch), loses nothing measurable at large batch
     fuse_double_forward: bool = False
+    # stack θ and θ⁻ on a leading axis and run ALL the step's Q-forwards
+    # (θ(s), θ(s') for Double-DQN, θ⁻(s')) as ONE vmapped application —
+    # the conv/dense chain count collapses to a single forward's worth
+    # (PERF.md §3: the small-batch step is op-count-bound). "auto" turns
+    # it on when the per-shard batch is ≤ 128 — at large batch the step
+    # is HBM/flop-bound and the extra θ⁻(s) quarter stops being free.
+    # Supersedes fuse_double_forward when active.
+    stack_forwards: str = "auto"  # auto | on | off
     # store Adam's first moment in bfloat16 (optax mu_dtype): trims
     # optimizer-state HBM traffic on the HBM-bound small-batch step
     adam_mu_dtype: str = "float32"  # float32 | bfloat16

@@ -157,6 +157,146 @@ class MlpTorso(nn.Module):
 
 
 # ---------------------------------------------------------------------------
+# Stacked-weight applications (op-count surgery)
+# ---------------------------------------------------------------------------
+#
+# A DQN step needs up to three torso forwards per minibatch — θ on s, θ on
+# s' (Double-DQN action selection), θ⁻ on s' — and the compiled step is
+# op-count-bound at small batch (~4.5 µs fixed cost per scheduled op on
+# the measured chip, PERF.md §3). Stacking θ and θ⁻ on a leading axis and
+# ``vmap``-ing the module apply collapses the three conv/dense chains into
+# ONE: jax's conv batching rule lowers a batched-kernel convolution to a
+# single grouped convolution, and batched Dense layers become one batched
+# ``dot_general``, so the scheduled conv count is that of a single
+# forward. Numerics are unchanged — each group/batch slice computes
+# exactly the per-net program (equivalence held by tests/test_op_surgery.py).
+
+
+def stack_pytrees(a: Any, b: Any) -> Any:
+    """Leaf-wise ``jnp.stack([a, b])`` of two same-structure pytrees."""
+    return jax.tree.map(lambda x, y: jnp.stack([x, y]), a, b)
+
+
+def stacked_q_forwards(
+    apply_fn, params: Any, target_params: Any,
+    obs: jax.Array, next_obs: jax.Array, double: bool,
+) -> tuple[jax.Array, jax.Array | None, jax.Array]:
+    """The train step's Q-forwards as ONE stacked application.
+
+    Returns ``(q, q_next_online, q_next_target)`` — ``q_next_online`` is
+    ``None`` when ``double`` is off, and carries ``stop_gradient`` (action
+    selection must not backprop into the online net) when on.
+
+    Double-DQN feeds both nets the same ``concat([s, s'])`` batch (the
+    θ⁻-on-s quarter is computed and discarded — at the small batches where
+    this path is selected the step is op-count-bound, not flop-bound, so
+    one wasted forward quarter buys a halved schedule); vanilla DQN stacks
+    ``[s, s']`` against ``[θ, θ⁻]`` with no wasted work at all.
+    """
+    return stacked_q_apply(apply_fn, stack_pytrees(params, target_params),
+                           obs, next_obs, double)
+
+
+def stacked_q_apply(
+    apply_fn, stacked: Any,
+    obs: jax.Array, next_obs: jax.Array, double: bool,
+) -> tuple[jax.Array, jax.Array | None, jax.Array]:
+    """``stacked_q_forwards`` against an ALREADY-stacked ``[2, ...]``-leaf
+    tree — the entry point for callers that hold θ/θ⁻ pre-stacked (the
+    chained device-PER program's flat parameter plane, where each stacked
+    leaf is a contiguous plane slice and re-stacking would cost a concat
+    per leaf per grad step)."""
+    if double:
+        b = obs.shape[0]
+        both = jnp.concatenate([obs, next_obs], axis=0)
+        qq = jax.vmap(apply_fn, in_axes=(0, None))(stacked, both)
+        q = qq[0, :b]
+        q_next_online = jax.lax.stop_gradient(qq[0, b:])
+        q_next_target = qq[1, b:]
+        return q, q_next_online, q_next_target
+    qq = jax.vmap(apply_fn)(stacked, jnp.stack([obs, next_obs]))
+    return qq[0], None, qq[1]
+
+
+def r2d2_torso_module(module: "R2d2QNet") -> nn.Module:
+    """The (unbound) torso submodule an ``R2d2QNet`` builds internally —
+    applying it standalone against the ``params["torso"]`` subtree is
+    exactly the in-module application (same scope, same leaves)."""
+    if module.torso == "nature_cnn":
+        return _NatureTorso(module.dtype)
+    return MlpTorso(tuple(module.hidden), module.dtype)
+
+
+def r2d2_features(module: "R2d2QNet", torso_params: Any,
+                  obs: jax.Array) -> jax.Array:
+    """Conv/MLP torso over a [B, T, ...] sequence block as ONE flattened
+    [B·T] batch → [B, T, F] float32 features. This is the hoisted half of
+    ``R2d2QNet.__call__``: the torso has no recurrence, so it never needs
+    to run inside the time scan — one large MXU-friendly batch replaces
+    per-window applications, and the conv count is independent of T."""
+    b, t = obs.shape[0], obs.shape[1]
+    flat = obs.reshape((b * t,) + obs.shape[2:])
+    feats = r2d2_torso_module(module).apply({"params": torso_params}, flat)
+    return feats.reshape(b, t, -1).astype(jnp.float32)
+
+
+def stacked_r2d2_features(module: "R2d2QNet", params: Any,
+                          target_params: Any, obs: jax.Array) -> jax.Array:
+    """θ and θ⁻ torso features for the SAME [B, T, ...] block in one
+    stacked-weight application → [2, B, T, F] (0 = online, 1 = target)."""
+    stacked = stack_pytrees(params["torso"], target_params["torso"])
+    return jax.vmap(lambda p: r2d2_features(module, p, obs))(stacked)
+
+
+def r2d2_param_split(params: Any) -> tuple[Any, Any, Any]:
+    """Split an ``R2d2QNet`` param tree into (torso, lstm_cell, head)
+    subtrees. The LSTM cell's scope name is flax-version-dependent (the
+    ``nn.RNN`` wrapper is scope-transparent here, so the cell lands at the
+    top level under its class-derived name), so it is located as the one
+    key that is neither ``torso`` nor ``head``."""
+    (lstm_key,) = [k for k in params if k not in ("torso", "head")]
+    return params["torso"], params[lstm_key], params["head"]
+
+
+def _lstm_scan(module: "R2d2QNet", lstm_params: Any, feats: jax.Array,
+               carry: Carry, with_outputs: bool) -> tuple[Carry, Any]:
+    """``lax.scan`` of the bare LSTM cell over [B, T, F] features — the
+    per-step math is exactly the cell ``R2d2QNet`` scans, applied against
+    the same param leaves, so values match the in-module RNN bitwise."""
+    cell = nn.OptimizedLSTMCell(module.lstm_size)
+
+    def step(c, x):
+        c2, y = cell.apply({"params": lstm_params}, c, x)
+        return c2, (y if with_outputs else None)
+
+    carry, hs = jax.lax.scan(step, carry, jnp.swapaxes(feats, 0, 1))
+    return carry, (jnp.swapaxes(hs, 0, 1) if with_outputs else None)
+
+
+def r2d2_burn_carry(module: "R2d2QNet", lstm_params: Any,
+                    feats: jax.Array, carry: Carry) -> Carry:
+    """LSTM-only burn-in: advance the carry over [B, T, F] features. The
+    head contributes nothing to the carry, so burn-in never computes Q."""
+    carry, _ = _lstm_scan(module, lstm_params, feats, carry,
+                          with_outputs=False)
+    return carry
+
+
+def r2d2_recur(module: "R2d2QNet", lstm_params: Any, head_params: Any,
+               feats: jax.Array, carry: Carry,
+               ) -> tuple[jax.Array, Carry]:
+    """LSTM + head over [B, T, F] features → (q [B, T, A], carry) — the
+    recurrent half of ``R2d2QNet.__call__``, fed precomputed features so
+    only the LSTM lives inside the time scan."""
+    b, t = feats.shape[0], feats.shape[1]
+    carry, hs = _lstm_scan(module, lstm_params, feats, carry,
+                           with_outputs=True)
+    q = _Head(module.num_actions, module.dueling, module.dtype).apply(
+        {"params": head_params}, hs.reshape(b * t, -1))
+    return q.reshape(b, t, module.num_actions), carry
+
+
+# ---------------------------------------------------------------------------
 # Factory + parameter helpers
 # ---------------------------------------------------------------------------
 

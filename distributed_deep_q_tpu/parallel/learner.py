@@ -21,7 +21,7 @@ optimizer state are updated in place in HBM with no per-step allocation churn.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple
 
 import flax.struct
 import jax
@@ -34,6 +34,8 @@ from distributed_deep_q_tpu.compat import safe_increment, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_deep_q_tpu.config import TrainConfig
+from distributed_deep_q_tpu.models.qnet import (
+    stacked_q_apply, stacked_q_forwards)
 from distributed_deep_q_tpu.ops.losses import bellman_targets, dqn_loss
 from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
 from distributed_deep_q_tpu.parallel.multihost import (
@@ -91,6 +93,28 @@ def clip_grads(cfg: TrainConfig, grads: Any,
     return jax.tree.map(lambda g: g * scale, grads), gnorm
 
 
+def _locate_adam_state(opt_state: Any):
+    """Locate the ScaleByAdamState inside whichever structure
+    ``make_optimizer`` built — bare adam (clip off) or
+    ``chain(clip_by_global_norm, adam)`` — preserving it exactly so
+    checkpoints stay resumable across both. Returns (adam_state,
+    rebuild) where ``rebuild(new_adam_state)`` reassembles the full
+    opt_state."""
+    if isinstance(opt_state[0], optax.ScaleByAdamState):
+        adam_state = opt_state[0]
+
+        def rebuild(s):
+            return (s,) + tuple(opt_state[1:])
+    else:
+        inner = opt_state[1]
+        adam_state = inner[0]
+
+        def rebuild(s):
+            return (opt_state[0], (s,) + tuple(inner[1:])) \
+                + tuple(opt_state[2:])
+    return adam_state, rebuild
+
+
 def fused_adam_step(cfg: TrainConfig, grads: Any, opt_state: Any,
                     params: Any, gnorm: jax.Array) -> tuple[Any, Any]:
     """Clip + Adam + parameter update in ONE multi-output fusion per leaf.
@@ -106,22 +130,33 @@ def fused_adam_step(cfg: TrainConfig, grads: Any, opt_state: Any,
 
     Returns (new opt_state, new params).
     """
-    # locate the ScaleByAdamState inside whichever structure
-    # make_optimizer built — bare adam (clip off) or
-    # chain(clip_by_global_norm, adam) — preserving it exactly so
-    # checkpoints stay resumable across both
-    if isinstance(opt_state[0], optax.ScaleByAdamState):
-        adam_state = opt_state[0]
+    opt_state, params, _ = fused_adam_target_step(
+        cfg, grads, opt_state, params, None, gnorm, None)
+    return opt_state, params
 
-        def rebuild(s):
-            return (s,) + tuple(opt_state[1:])
-    else:
-        inner = opt_state[1]
-        adam_state = inner[0]
 
-        def rebuild(s):
-            return (opt_state[0], (s,) + tuple(inner[1:])) \
-                + tuple(opt_state[2:])
+def fused_adam_target_step(
+    cfg: TrainConfig, grads: Any, opt_state: Any, params: Any,
+    target_params: Any, gnorm: jax.Array, step: jax.Array | None,
+) -> tuple[Any, Any, Any]:
+    """``fused_adam_step`` with the target refresh folded into the SAME
+    per-leaf multi-output fusion.
+
+    The ``lax.cond``-based ``refresh_target`` schedules a whole-tree COPY
+    of whichever branch it takes — 13 scheduled copies per step on the
+    13-leaf Nature net, pure per-op overhead on the op-count-bound small
+    batch step. Folded here the refresh is one extra elementwise output
+    per leaf fusion: Polyak ``τ·p₂ + (1−τ)·t`` when ``target_tau`` > 0,
+    else ``where(step % C == 0, p₂, t)`` — a select, bitwise-identical
+    to the cond's chosen branch. ``step`` is the ALREADY-incremented
+    step (the refresh condition matches ``refresh_target``'s).
+
+    With ``target_params=None`` this is plain ``fused_adam_step``
+    (returned target tree is ``None``).
+
+    Returns (new opt_state, new params, new target_params).
+    """
+    adam_state, rebuild = _locate_adam_state(opt_state)
     b1, b2 = ADAM_B1, ADAM_B2
     count = safe_increment(adam_state.count)
     c = count.astype(jnp.float32)
@@ -132,21 +167,41 @@ def fused_adam_step(cfg: TrainConfig, grads: Any, opt_state: Any,
              if cfg.grad_clip_norm > 0 else jnp.float32(1.0))
     lr, eps = cfg.lr, cfg.adam_eps
     mu_dtype = jnp.dtype(cfg.adam_mu_dtype)
+    with_target = target_params is not None
+    if with_target:
+        if cfg.target_tau > 0:
+            tau = cfg.target_tau
 
-    def leaf(g, m, v, p):
+            def tleaf(p2, t):
+                return tau * p2 + (1.0 - tau) * t
+        else:
+            do_refresh = step % cfg.target_update_period == 0
+
+            def tleaf(p2, t):
+                return jnp.where(do_refresh, p2, t)
+
+    def leaf(g, m, v, p, *rest):
         g = g * scale
         m2 = b1 * m.astype(jnp.float32) + (1.0 - b1) * g
         v2 = b2 * v + (1.0 - b2) * jnp.square(g)
         upd = (m2 / bc1) / (jnp.sqrt(v2 / bc2) + eps)
-        return m2.astype(mu_dtype), v2, p - lr * upd
+        p2 = p - lr * upd
+        if with_target:
+            return m2.astype(mu_dtype), v2, p2, tleaf(p2, rest[0])
+        return m2.astype(mu_dtype), v2, p2
 
-    out = jax.tree.map(leaf, grads, adam_state.mu, adam_state.nu, params)
+    trees = (grads, adam_state.mu, adam_state.nu, params)
+    if with_target:
+        trees += (target_params,)
+    out = jax.tree.map(leaf, *trees)
     treedef = jax.tree_util.tree_structure(grads)
-    mu, nu, params = (jax.tree_util.tree_unflatten(
+    parts = [jax.tree_util.tree_unflatten(
         treedef, [t[i] for t in jax.tree_util.tree_leaves(
             out, is_leaf=lambda x: isinstance(x, tuple))])
-        for i in range(3))
-    return rebuild(adam_state._replace(count=count, mu=mu, nu=nu)), params
+        for i in range(4 if with_target else 3)]
+    new_opt = rebuild(adam_state._replace(count=count, mu=parts[0],
+                                          nu=parts[1]))
+    return new_opt, parts[2], (parts[3] if with_target else None)
 
 
 def refresh_target(cfg: TrainConfig, params: Any, target_params: Any,
@@ -164,6 +219,178 @@ def refresh_target(cfg: TrainConfig, params: Any, target_params: Any,
         lambda: params,
         lambda: target_params,
     )
+
+
+# -- flat parameter/moment planes (op-count surgery, PERF.md §3) -----------
+#
+# The chained device-PER program's scan body used to pay the optimizer as
+# per-leaf kernels: on a backend without multi-output fusion (CPU XLA — the
+# ratchet's measurement platform) the "one fusion per leaf" fused update
+# decomposes into ~5 scheduled fusions PER LEAF, plus a per-leaf stack
+# concat feeding the stacked forward and a per-leaf gnorm partial — ~85 of
+# the body's ~125 scheduled ops for a 12-leaf Nature net. The fix: carry
+# θ/θ⁻ as ONE flat f32 plane and the Adam moments as two more, so the
+# whole optimizer is a fixed handful of plane-wide kernels independent of
+# leaf count. Layout of the PT plane ([2N], N = total param count): per
+# leaf the online and target blocks sit ADJACENT ([θ_i; θ⁻_i] at offset
+# 2·off_i), so the stacked ``[2, shape]`` leaf view the vmapped forward
+# wants is a contiguous slice — free, where a [P; T] split layout would
+# pay a concat per leaf per step. Tree↔plane conversion happens once per
+# chunk at the scan boundary, amortized over ``chain`` grad steps.
+
+class PlaneMeta(NamedTuple):
+    """Static layout of the flat planes, derived from the param treedef.
+
+    ``upd_map``/``src_map``/``onl`` are host-side constants baked into the
+    program: ``upd_map`` sends every PT position to its leaf's online
+    position in the [N] update plane (both halves — the target half reuses
+    the online update on refresh); ``src_map`` mirrors each target
+    position onto its online twin (identity on the online half); ``onl``
+    marks the online half."""
+    treedef: Any
+    shapes: tuple
+    sizes: tuple
+    offsets: tuple
+    n: int
+    upd_map: np.ndarray
+    src_map: np.ndarray
+    onl: np.ndarray
+
+
+def plane_meta(params: Any) -> PlaneMeta:
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shapes = tuple(leaf.shape for leaf in leaves)
+    sizes = tuple(int(np.prod(s, dtype=np.int64)) for s in shapes)
+    offsets = tuple(int(o) for o in np.cumsum((0,) + sizes[:-1]))
+    n = int(sum(sizes))
+    upd_map = np.empty(2 * n, np.int32)
+    src_map = np.empty(2 * n, np.int32)
+    onl = np.zeros(2 * n, bool)
+    for off, size in zip(offsets, sizes):
+        o2 = 2 * off
+        upd = np.arange(off, off + size, dtype=np.int32)
+        upd_map[o2:o2 + size] = upd
+        upd_map[o2 + size:o2 + 2 * size] = upd
+        src = np.arange(o2, o2 + size, dtype=np.int32)
+        src_map[o2:o2 + size] = src
+        src_map[o2 + size:o2 + 2 * size] = src
+        onl[o2:o2 + size] = True
+    return PlaneMeta(treedef, shapes, sizes, offsets, n,
+                     upd_map, src_map, onl)
+
+
+def params_to_plane(meta: PlaneMeta, params: Any,
+                    target_params: Any) -> jax.Array:
+    """Interleave θ/θ⁻ into the [2N] PT plane (leaf blocks adjacent)."""
+    blocks = []
+    for p, t in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(target_params)):
+        blocks.append(p.reshape(-1).astype(jnp.float32))
+        blocks.append(t.reshape(-1).astype(jnp.float32))
+    return jnp.concatenate(blocks)
+
+
+def tree_to_plane(tree: Any) -> jax.Array:
+    """Ravel-and-concat a tree into its [N] plane (moment planes keep
+    their storage dtype so per-step round trips stay bitwise)."""
+    return jnp.concatenate(
+        [leaf.reshape(-1) for leaf in jax.tree_util.tree_leaves(tree)])
+
+
+def plane_stacked_views(meta: PlaneMeta, pt: jax.Array) -> tuple:
+    """The [2, shape] stacked leaf views of the PT plane — contiguous
+    slices (the layout's whole point), fed to ``stacked_q_apply``."""
+    return tuple(
+        pt[2 * off:2 * off + 2 * size].reshape((2,) + shape)
+        for off, size, shape in zip(meta.offsets, meta.sizes, meta.shapes))
+
+
+def plane_to_param_trees(meta: PlaneMeta, pt: jax.Array,
+                         params: Any, target_params: Any) -> tuple:
+    """Inverse of ``params_to_plane`` — dtypes restored per template."""
+    new_p, new_t = [], []
+    for off, size, shape, tmpl in zip(
+            meta.offsets, meta.sizes, meta.shapes,
+            jax.tree_util.tree_leaves(params)):
+        o2 = 2 * off
+        new_p.append(pt[o2:o2 + size].reshape(shape).astype(tmpl.dtype))
+        new_t.append(
+            pt[o2 + size:o2 + 2 * size].reshape(shape).astype(tmpl.dtype))
+    return (jax.tree_util.tree_unflatten(meta.treedef, new_p),
+            jax.tree_util.tree_unflatten(meta.treedef, new_t))
+
+
+def plane_to_tree(meta: PlaneMeta, plane: jax.Array,
+                  template: Any) -> Any:
+    """Slice an [N] plane back into ``template``'s tree structure."""
+    leaves = [
+        plane[off:off + size].reshape(shape).astype(tmpl.dtype)
+        for off, size, shape, tmpl in zip(
+            meta.offsets, meta.sizes, meta.shapes,
+            jax.tree_util.tree_leaves(template))]
+    return jax.tree_util.tree_unflatten(meta.treedef, leaves)
+
+
+def fused_plane_adam_target_step(
+    cfg: TrainConfig, meta: PlaneMeta, g: jax.Array, m: jax.Array,
+    v: jax.Array, count: jax.Array, pt: jax.Array, step: jax.Array,
+    gnorm: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """``fused_adam_target_step`` on the flat planes: clip + Adam + the
+    parameter/target update as a FIXED number of plane-wide kernels
+    (two multiply-adds, two gathers, one select/lerp) regardless of how
+    many leaves the net has. Per-element arithmetic is identical to the
+    per-leaf version (the maps only permute positions), so the hard
+    refresh stays a bitwise select of the freshly-updated online value.
+    ``g`` is the [N] online-layout gradient plane (already allreduced);
+    ``step`` the already-incremented step. Returns (m2, v2, pt2, count2).
+    """
+    b1, b2 = ADAM_B1, ADAM_B2
+    count2 = safe_increment(count)
+    c = count2.astype(jnp.float32)
+    bc1 = 1.0 - b1 ** c
+    bc2 = 1.0 - b2 ** c
+    scale = (jnp.minimum(1.0, cfg.grad_clip_norm
+                         / jnp.maximum(gnorm, 1e-12))
+             if cfg.grad_clip_norm > 0 else jnp.float32(1.0))
+    g = g * scale
+    m2 = b1 * m.astype(jnp.float32) + (1.0 - b1) * g
+    v2 = b2 * v + (1.0 - b2) * jnp.square(g)
+    # lr folded into the denominator: the final update must be a
+    # SUBTRACT-OF-A-DIVISION, not subtract-of-a-multiply — a mul feeding
+    # the sub is FMA-contractible, and LLVM contracts it in one unroll
+    # context but not the other, breaking the chain=k ≡ k × chain=1
+    # bitwise guarantee (measured: ~300 one-ulp params diffs per step)
+    upd = (m2 / bc1) / ((jnp.sqrt(v2 / bc2) + cfg.adam_eps)
+                        * np.float32(1.0 / cfg.lr))
+    # candidate value for EVERY PT position: its (fresh) online twin
+    p2t = jnp.take(pt, meta.src_map) - jnp.take(upd, meta.upd_map)
+    if cfg.target_tau > 0:
+        w = jnp.asarray(np.where(meta.onl, 1.0, cfg.target_tau),
+                        jnp.float32)
+        pt2 = w * p2t + (1.0 - w) * pt
+    else:
+        take = jnp.asarray(meta.onl) | (
+            step % cfg.target_update_period == 0)
+        pt2 = jnp.where(take, p2t, pt)
+    return m2.astype(jnp.dtype(cfg.adam_mu_dtype)), v2, pt2, count2
+
+
+def q_step_loss(cfg: TrainConfig, q: jax.Array, q_next_o: jax.Array | None,
+                q_next_t: jax.Array, batch: dict[str, jax.Array]):
+    """Bellman targets + (Pallas or XLA) weighted Huber — the loss tail
+    shared by the tree-carry and plane-carry step cores, so the two paths
+    can never drift numerically. Returns (loss, |TD|)."""
+    targets = bellman_targets(batch["reward"], batch["discount"],
+                              q_next_t, q_next_o, cfg.double_dqn)
+    if cfg.use_pallas_loss:
+        from distributed_deep_q_tpu.ops.pallas_kernels import (
+            fused_dqn_loss)
+        return fused_dqn_loss(q, batch["action"],
+                              lax.stop_gradient(targets),
+                              batch["weight"], cfg.huber_delta)
+    return dqn_loss(q, batch["action"], targets, batch["weight"],
+                    cfg.huber_delta)
 
 
 class Learner:
@@ -211,9 +438,21 @@ class Learner:
         host-batch and device-ring paths. ``batch`` holds per-device local
         arrays with ``obs``/``next_obs`` already composed."""
         cfg, apply_fn, opt = self.cfg, self.apply_fn, self.opt
+        # static at trace time: per-shard batch decides the auto gate
+        use_stacked = (cfg.stack_forwards == "on"
+                       or (cfg.stack_forwards == "auto"
+                           and batch["obs"].shape[0] <= 128))
 
         def loss_fn(params):
-            if cfg.double_dqn and cfg.fuse_double_forward:
+            if use_stacked:
+                # ALL the step's forwards — θ(s), θ(s') when double, and
+                # θ⁻(s') — as one stacked-weight application: the conv
+                # batching rule lowers the whole thing to a single conv
+                # chain (models/qnet.py, stacked_q_forwards)
+                q, q_next_o, q_next_t = stacked_q_forwards(
+                    apply_fn, params, state.target_params,
+                    batch["obs"], batch["next_obs"], cfg.double_dqn)
+            elif cfg.double_dqn and cfg.fuse_double_forward:
                 # one conv application for s AND s' (cfg docstring): the
                 # split's s' half carries zero cotangents back (action
                 # selection must not backprop into the online net)
@@ -221,6 +460,8 @@ class Learner:
                     [batch["obs"], batch["next_obs"]], axis=0))
                 q, q_next_o = jnp.split(qq, 2, axis=0)
                 q_next_o = lax.stop_gradient(q_next_o)
+                q_next_t = apply_fn(state.target_params,
+                                    batch["next_obs"])
             else:
                 q = apply_fn(params, batch["obs"])
                 q_next_o = (apply_fn(params, batch["next_obs"])
@@ -228,20 +469,9 @@ class Learner:
                 # action selection must not backprop into the online net
                 if q_next_o is not None:
                     q_next_o = lax.stop_gradient(q_next_o)
-            q_next_t = apply_fn(state.target_params, batch["next_obs"])
-            targets = bellman_targets(
-                batch["reward"], batch["discount"], q_next_t,
-                q_next_o, cfg.double_dqn)
-            if cfg.use_pallas_loss:
-                from distributed_deep_q_tpu.ops.pallas_kernels import (
-                    fused_dqn_loss)
-                loss, td_abs = fused_dqn_loss(
-                    q, batch["action"], lax.stop_gradient(targets),
-                    batch["weight"], cfg.huber_delta)
-            else:
-                loss, td_abs = dqn_loss(
-                    q, batch["action"], targets, batch["weight"],
-                    cfg.huber_delta)
+                q_next_t = apply_fn(state.target_params,
+                                    batch["next_obs"])
+            loss, td_abs = q_step_loss(cfg, q, q_next_o, q_next_t, batch)
             return loss, (td_abs, q)
 
         (loss, (td_abs, q)), grads = jax.value_and_grad(
@@ -254,19 +484,20 @@ class Learner:
         q_mean = lax.pmean(jnp.mean(q), AXIS_DP)
 
         gnorm = optax.global_norm(grads)
+        step = state.step + 1
         if cfg.optimizer == "adam":
-            # clip folded into the one-pass fused update (op-count-bound
-            # step — see fused_adam_step)
-            opt_state, params = fused_adam_step(
-                cfg, grads, state.opt_state, state.params, gnorm)
+            # clip AND target refresh folded into the one-pass fused
+            # update (op-count-bound step — see fused_adam_target_step)
+            opt_state, params, target_params = fused_adam_target_step(
+                cfg, grads, state.opt_state, state.params,
+                state.target_params, gnorm, step)
         else:
             grads, gnorm = clip_grads(cfg, grads, gnorm)
             updates, opt_state = opt.update(grads, state.opt_state,
                                             state.params)
             params = optax.apply_updates(state.params, updates)
-        step = state.step + 1
-
-        target_params = refresh_target(cfg, params, state.target_params, step)
+            target_params = refresh_target(cfg, params,
+                                           state.target_params, step)
         new_state = TrainState(params, target_params, opt_state, step)
         metrics = {
             "loss": loss,
@@ -327,7 +558,8 @@ class Learner:
             self._ring_steps[key] = self._build_ring_step(key)
         return self._ring_steps[key](state, ring, batch)
 
-    def _build_device_per_step(self, spec: tuple, chain: int):
+    def _build_device_per_step(self, spec: tuple, chain: int,
+                               donate: bool = True):
         """Fused prioritized step (replay/device_per.py): per shard —
         validity mask → inverse-CDF prioritized draw → on-device stack +
         n-step composition → DQN step → same-step priority scatter. The
@@ -394,22 +626,34 @@ class Learner:
             out_specs=(meta_spec, SWIN, SK),
             check_vma=False))
 
-        def train_fn(state: TrainState, metas, win, idxs, prio, maxp):
+        cfg = self.cfg
+        # static gates (spec's per_shard is the in-shard batch, the same
+        # quantity _step_core's auto gate reads off the traced batch)
+        use_stacked = (cfg.stack_forwards == "on"
+                       or (cfg.stack_forwards == "auto"
+                           and per_shard <= 128))
+        use_plane = use_stacked and cfg.optimizer == "adam"
+
+        def unpack_batch(batch, w):
+            batch = dict(batch)
+            ovalid = batch.pop("ovalid")
+            nvalid = batch.pop("nvalid")
+            # unpack int32 → pixel bytes (little-endian round trip
+            # with the host's uint8.view(int32), verified both
+            # platforms), drop the DMA row padding
+            pix = lax.bitcast_convert_type(w, jnp.uint8)
+            pix = pix.reshape(w.shape[:2] + (rowp * 4,))[:, :, :row_len]
+            obs = pix[:, :stack] * ovalid[..., None]
+            nobs = pix[:, n_step:n_step + stack] * nvalid[..., None]
+            batch["obs"] = stack_rows_to_obs(obs, frame_shape)
+            batch["next_obs"] = stack_rows_to_obs(nobs, frame_shape)
+            return batch
+
+        def tree_train_fn(state: TrainState, metas, win, idxs, prio, maxp):
             def body(carry, xs):
                 state, prio, maxp = carry
                 batch, w, idx = xs
-                batch = dict(batch)
-                ovalid = batch.pop("ovalid")
-                nvalid = batch.pop("nvalid")
-                # unpack int32 → pixel bytes (little-endian round trip
-                # with the host's uint8.view(int32), verified both
-                # platforms), drop the DMA row padding
-                pix = lax.bitcast_convert_type(w, jnp.uint8)
-                pix = pix.reshape(w.shape[:2] + (rowp * 4,))[:, :, :row_len]
-                obs = pix[:, :stack] * ovalid[..., None]
-                nobs = pix[:, n_step:n_step + stack] * nvalid[..., None]
-                batch["obs"] = stack_rows_to_obs(obs, frame_shape)
-                batch["next_obs"] = stack_rows_to_obs(nobs, frame_shape)
+                batch = unpack_batch(batch, w)
                 state, metrics, td_abs = self._step_core(state, batch)
                 prio, maxp = scatter_priorities(prio, maxp, idx, td_abs,
                                                 alpha, eps)
@@ -419,11 +663,84 @@ class Learner:
                 body, (state, prio, maxp), (metas, win, idxs))
             return state, prio, maxp, metrics
 
+        def plane_train_fn(state: TrainState, metas, win, idxs, prio,
+                           maxp):
+            # The op-count-surgery body (PERF.md §3): θ/θ⁻ ride the scan
+            # carry as ONE flat plane (moments as two more), so the whole
+            # optimizer + target refresh is a fixed handful of plane-wide
+            # kernels instead of ~5 scheduled fusions per leaf, and every
+            # stacked leaf view feeding the vmapped forward is a free
+            # contiguous slice. Tree↔plane conversion sits OUTSIDE the
+            # scan, amortized over the chain. Per-step math is the same
+            # fused clip+Adam+refresh (see fused_plane_adam_target_step);
+            # the one deliberate deviation is the gradient norm, computed
+            # as a single flat reduce over the g-plane rather than
+            # optax.global_norm's per-leaf partial sums — same value to
+            # f32 ulp, one kernel instead of thirteen.
+            meta = plane_meta(state.params)
+            adam_state, rebuild = _locate_adam_state(state.opt_state)
+            pt = params_to_plane(meta, state.params, state.target_params)
+            m = tree_to_plane(adam_state.mu)
+            v = tree_to_plane(adam_state.nu)
+
+            def body(carry, xs):
+                pt, m, v, cnt, step, prio, maxp = carry
+                batch, w, idx = xs
+                batch = unpack_batch(batch, w)
+                step2 = step + 1
+
+                def loss_fn(views):
+                    stacked = jax.tree_util.tree_unflatten(
+                        meta.treedef, list(views))
+                    q, q_next_o, q_next_t = stacked_q_apply(
+                        self.apply_fn, stacked, batch["obs"],
+                        batch["next_obs"], cfg.double_dqn)
+                    loss, td_abs = q_step_loss(cfg, q, q_next_o,
+                                               q_next_t, batch)
+                    return loss, (td_abs, q)
+
+                (loss, (td_abs, q)), gv = jax.value_and_grad(
+                    loss_fn, has_aux=True)(plane_stacked_views(meta, pt))
+                # online halves only — the target halves carry zero
+                # cotangents (targets are stop-gradded in the loss)
+                g = jnp.concatenate([x[0].reshape(-1) for x in gv])
+                g = lax.pmean(g, AXIS_DP)
+                loss = lax.pmean(loss, AXIS_DP)
+                q_mean = lax.pmean(jnp.mean(q), AXIS_DP)
+                gnorm = jnp.sqrt(jnp.sum(jnp.square(g)))
+                m, v, pt, cnt = fused_plane_adam_target_step(
+                    cfg, meta, g, m, v, cnt, pt, step2, gnorm)
+                prio, maxp = scatter_priorities(prio, maxp, idx, td_abs,
+                                                alpha, eps)
+                metrics = {"loss": loss, "q_mean": q_mean,
+                           "grad_norm": gnorm}
+                return (pt, m, v, cnt, step2, prio, maxp), metrics
+
+            carry0 = (pt, m, v, adam_state.count, state.step, prio, maxp)
+            (pt, m, v, cnt, step, prio, maxp), metrics = lax.scan(
+                body, carry0, (metas, win, idxs))
+            params, target_params = plane_to_param_trees(
+                meta, pt, state.params, state.target_params)
+            new_opt = rebuild(adam_state._replace(
+                count=cnt, mu=plane_to_tree(meta, m, adam_state.mu),
+                nu=plane_to_tree(meta, v, adam_state.nu)))
+            new_state = TrainState(params, target_params, new_opt, step)
+            return new_state, prio, maxp, metrics
+
+        train_fn = plane_train_fn if use_plane else tree_train_fn
+
+        # donate every input that aliases an updated output: the state
+        # tree (0) and the priority plane/max (4, 5) are rewritten each
+        # call, so XLA writes the new values in place instead of
+        # scheduling defensive copies of the (large) param/priority
+        # buffers. metas/win/idxs are consumed exactly once but have no
+        # same-shaped output to alias, so donating them buys nothing.
         train = jax.jit(shard_map(
             train_fn, mesh=self.mesh,
             in_specs=(P(), meta_spec, SWIN, SK, S, P()),
             out_specs=(P(), S, P(), P()),
-            check_vma=False), donate_argnums=(0, 4, 5))
+            check_vma=False),
+            donate_argnums=(0, 4, 5) if donate else ())
         return sample, train
 
     def train_steps_device_per(self, state: TrainState, rows, cursors,

@@ -38,10 +38,12 @@ from distributed_deep_q_tpu.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_deep_q_tpu.config import ReplayConfig, TrainConfig
+from distributed_deep_q_tpu.models.qnet import (
+    r2d2_burn_carry, r2d2_param_split, r2d2_recur, stacked_r2d2_features)
 from distributed_deep_q_tpu.ops.losses import (
     sequence_bellman_targets, sequence_dqn_loss)
 from distributed_deep_q_tpu.parallel.learner import (
-    TrainState, clip_grads, fused_adam_step, make_optimizer,
+    TrainState, clip_grads, fused_adam_target_step, make_optimizer,
     refresh_target)
 from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
 from distributed_deep_q_tpu.parallel.multihost import (
@@ -88,21 +90,55 @@ class SequenceLearner:
         def step_fn(state: TrainState, batch: dict[str, jax.Array]):
             obs = batch["obs"]                    # [B, T_total+1, ...]
             carry0 = (batch["init_c"], batch["init_h"])
+            # static gate, same policy as Learner._step_core: the stacked
+            # time-batched torso wins whenever the step is op-count-bound
+            use_stacked = (cfg.stack_forwards == "on"
+                           or (cfg.stack_forwards == "auto"
+                               and obs.shape[0] <= 128))
 
             def loss_fn(params):
-                # burn-in from the stored carry; gradients cut at the seam
-                if burn > 0:
-                    _, carry_on = apply_seq(params, obs[:, :burn], carry0)
-                    carry_on = lax.stop_gradient(carry_on)
-                    _, carry_tg = apply_seq(state.target_params,
-                                            obs[:, :burn], carry0)
+                if use_stacked:
+                    # Op-count surgery (PERF.md §4): the conv torso runs
+                    # ONCE, time-batched over ALL [B·(T_total+1)] frames —
+                    # burn-in included — for θ AND θ⁻ together (stacked
+                    # weights, models/qnet.py); only the LSTM recurs. The
+                    # scheduled conv count is therefore independent of
+                    # both the sequence length and the number of nets,
+                    # where the module-apply path pays four separate conv
+                    # chains (on/target × burn/window). Gradients still
+                    # cut at the burn-in seam: the burn features only
+                    # reach the loss through the stop-gradded carry.
+                    feats = stacked_r2d2_features(
+                        module, params, state.target_params, obs)
+                    _, l_on, h_on = r2d2_param_split(params)
+                    _, l_tg, h_tg = r2d2_param_split(state.target_params)
+                    f_on, f_tg = feats[0], feats[1]
+                    if burn > 0:
+                        carry_on = lax.stop_gradient(r2d2_burn_carry(
+                            module, l_on, f_on[:, :burn], carry0))
+                        carry_tg = r2d2_burn_carry(
+                            module, l_tg, f_tg[:, :burn], carry0)
+                    else:
+                        carry_on = carry_tg = carry0
+                    q_all, _ = r2d2_recur(module, l_on, h_on,
+                                          f_on[:, burn:], carry_on)
+                    q_tgt_all, _ = r2d2_recur(module, l_tg, h_tg,
+                                              f_tg[:, burn:], carry_tg)
                 else:
-                    carry_on = carry_tg = carry0
+                    # burn-in from the stored carry; grads cut at the seam
+                    if burn > 0:
+                        _, carry_on = apply_seq(params, obs[:, :burn],
+                                                carry0)
+                        carry_on = lax.stop_gradient(carry_on)
+                        _, carry_tg = apply_seq(state.target_params,
+                                                obs[:, :burn], carry0)
+                    else:
+                        carry_on = carry_tg = carry0
 
-                # train window: T+1 obs → q for steps and for bootstraps
-                q_all, _ = apply_seq(params, obs[:, burn:], carry_on)
-                q_tgt_all, _ = apply_seq(state.target_params, obs[:, burn:],
-                                         carry_tg)
+                    # train window: T+1 obs → q for steps and bootstraps
+                    q_all, _ = apply_seq(params, obs[:, burn:], carry_on)
+                    q_tgt_all, _ = apply_seq(state.target_params,
+                                             obs[:, burn:], carry_tg)
                 q = q_all[:, :-1]                           # [B, T, A]
                 q_next_online = lax.stop_gradient(q_all[:, 1:])
                 q_next_target = q_tgt_all[:, 1:]
@@ -125,17 +161,21 @@ class SequenceLearner:
             q_mean = lax.pmean(jnp.mean(q), AXIS_DP)
 
             gnorm = optax.global_norm(grads)
+            step = state.step + 1
             if cfg.optimizer == "adam":
-                opt_state, params = fused_adam_step(
-                    cfg, grads, state.opt_state, state.params, gnorm)
+                # clip + Adam + target refresh in the one fused tree pass
+                # (the lax.cond refresh scheduled a whole-tree copy per
+                # step — see fused_adam_target_step)
+                opt_state, params, target_params = fused_adam_target_step(
+                    cfg, grads, state.opt_state, state.params,
+                    state.target_params, gnorm, step)
             else:
                 grads, gnorm = clip_grads(cfg, grads, gnorm)
                 updates, opt_state = opt.update(grads, state.opt_state,
                                                 state.params)
                 params = optax.apply_updates(state.params, updates)
-            step = state.step + 1
-            target_params = refresh_target(cfg, params, state.target_params,
-                                           step)
+                target_params = refresh_target(cfg, params,
+                                               state.target_params, step)
             new_state = TrainState(params, target_params, opt_state, step)
             metrics = {
                 "loss": loss,
@@ -194,6 +234,9 @@ class SequenceLearner:
             batch["obs"] = jnp.moveaxis(obs, 2, -1)  # [b, T+1, H, W, S]
             return self._step_core(state, batch)
 
+        # donate the state tree: params/target/opt alias their updated
+        # outputs, so the optimizer writes in place. The pixel block has
+        # no same-shaped output to alias — donating it would be a no-op.
         train = jax.jit(shard_map(
             train_fn, mesh=self.mesh,
             in_specs=(P(), S, S),
@@ -297,6 +340,11 @@ class SequenceLearner:
                 body, (state, prio, maxp), (metas, win, idxs))
             return state, prio, maxp, metrics
 
+        # donate every input with an updated output to alias (transition
+        # path's discipline): the state tree (0) and prio/maxp (4, 5) are
+        # rewritten in place instead of through defensive copies. metas/
+        # win/idxs have no same-shaped output, so donating them is a no-op
+        # (XLA donation is strictly output aliasing).
         train = jax.jit(shard_map(
             train_fn, mesh=self.mesh,
             in_specs=(P(), meta_spec, SWIN, SK, S, P()),

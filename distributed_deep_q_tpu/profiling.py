@@ -24,6 +24,7 @@ rebuild gets two first-class tools:
 from __future__ import annotations
 
 import contextlib
+import re
 import time
 from collections import defaultdict
 from typing import Iterator
@@ -31,6 +32,143 @@ from typing import Iterator
 import jax
 
 from distributed_deep_q_tpu.metrics import Histogram
+
+
+# -- compiled-HLO op census (the op-count ratchet's measurement) -----------
+
+# NB: the param list may hold nested parens (tuple-typed while-body
+# params), so the body is matched greedily; op-definition lines can't
+# collide — they carry " = " and never end with "{".
+_HLO_COMP_RE = re.compile(
+    r"^\s*(?:ENTRY\s+)?(%[\w.\-]+)\s*\(.*\)\s*->\s*.+\{\s*$")
+_HLO_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*[^=]*?\s([a-z][\w\-]*)\(")
+_HLO_CALLS_RE = re.compile(
+    r"(?:calls|to_apply|body|condition|true_computation|"
+    r"false_computation)=(%[\w.\-]+)")
+_HLO_CALLS_SET_RE = re.compile(
+    r"(?:calls|called_computations|branch_computations)=\{([^}]*)\}")
+# opcodes whose referenced computation runs INSIDE the one dispatched
+# kernel (fused/applied elementwise) — its ops are not scheduled
+_HLO_WRAPPER_OPS = frozenset({
+    "fusion", "reduce", "reduce-window", "reduce-scatter", "all-reduce",
+    "scatter", "select-and-scatter", "sort", "map", "reduce-precision",
+})
+
+
+def hlo_op_census(hlo_text: str,
+                  ops: tuple[str, ...] = ("fusion", "convolution", "copy"),
+                  ) -> dict[str, int]:
+    """Count SCHEDULED ops in a compiled HLO module's text.
+
+    "Scheduled" = ops the runtime dispatches: everything in the entry
+    computation plus control-flow computations (while/conditional bodies
+    and outlined ``call`` targets — their ops run when the loop/branch
+    does), EXCLUDING the sub-computations that fusions and reducers
+    merely wrap (their ops execute inside the one fused kernel, which is
+    the whole point of counting this way: the step cost model is
+    ~constant per *scheduled* op, PERF.md §3). A ``calls=``/``to_apply=``
+    reference excludes its target only when the referencing op is a
+    fusion/reduction-style wrapper — a ``call``'s target (XLA outlines
+    scan bodies this way on CPU) stays counted.
+
+    Returns ``{op: count for op in ops}`` plus ``"scheduled_total"``
+    (all scheduled ops except parameter/constant declarations).
+    """
+    bodies, fused, _ = _parse_hlo_computations(hlo_text)
+    counts = {op: 0 for op in ops}
+    counts["scheduled_total"] = 0
+    for name, opcodes in bodies.items():
+        if name in fused:
+            continue
+        _count_into(counts, opcodes)
+    return counts
+
+
+def hlo_scan_body_census(
+    hlo_text: str,
+    ops: tuple[str, ...] = ("fusion", "convolution", "copy"),
+) -> dict[str, int]:
+    """``hlo_op_census`` of the LARGEST scheduled non-entry computation
+    plus everything it reaches through call/while/conditional references
+    — for a chained (``lax.scan``-over-grad-steps) train program that is
+    the loop body, i.e. the op count paid PER GRAD STEP (the quantity
+    PERF.md §3's per-op cost model prices; CPU XLA outlines e.g. each
+    threaded convolution into its own ``call``-referenced computation,
+    which executes per iteration and must count). Falls back to the
+    whole-module census when no substantial non-entry computation exists
+    (unchained programs)."""
+    bodies, fused, refs = _parse_hlo_computations(hlo_text)
+    best: str | None = None
+    for name, opcodes in bodies.items():
+        if name in fused or name.startswith("%ENTRY"):
+            continue
+        if best is None or len(opcodes) > len(bodies[best]):
+            best = name
+    counts = {op: 0 for op in ops}
+    counts["scheduled_total"] = 0
+    if best is None or len(bodies[best]) < 8:
+        return hlo_op_census(hlo_text, ops)
+    seen: set[str] = set()
+    frontier = [best]
+    while frontier:
+        name = frontier.pop()
+        if name in seen or name in fused or name not in bodies:
+            continue
+        seen.add(name)
+        _count_into(counts, bodies[name])
+        frontier.extend(refs.get(name, ()))
+    return counts
+
+
+def _parse_hlo_computations(hlo_text: str) -> tuple[
+        dict[str, list[str]], set[str], dict[str, set[str]]]:
+    """→ (ops per computation, fusion-wrapped computation names,
+    call-style references per computation)."""
+    bodies: dict[str, list[str]] = {}
+    fused: set[str] = set()
+    refs: dict[str, set[str]] = {}
+    current: list[str] | None = None
+    cur_name = ""
+    for line in hlo_text.splitlines():
+        comp = _HLO_COMP_RE.match(line)
+        if comp and line.rstrip().endswith("{"):
+            entry = line.lstrip().startswith("ENTRY")
+            cur_name = ("%ENTRY" if entry else "") + comp.group(1)
+            current = bodies.setdefault(cur_name, [])
+            continue
+        if line.strip() == "}":
+            current = None
+            continue
+        m = _HLO_OP_RE.match(line)
+        if m is None or current is None:
+            continue
+        opcode = m.group(1)
+        current.append(opcode)
+        targets: list[str] = list(_HLO_CALLS_RE.findall(line))
+        for group in _HLO_CALLS_SET_RE.findall(line):
+            targets.extend(ref.strip() for ref in group.split(",")
+                           if ref.strip().startswith("%"))
+        if opcode in _HLO_WRAPPER_OPS:
+            fused.update(targets)
+        elif targets:
+            refs.setdefault(cur_name, set()).update(targets)
+    return bodies, fused, refs
+
+
+def _count_into(counts: dict[str, int], opcodes: list[str]) -> None:
+    for op in opcodes:
+        if op not in ("parameter", "constant"):
+            counts["scheduled_total"] += 1
+        if op in counts:
+            counts[op] += 1
+
+
+def compiled_op_census(jitted, *args, **kwargs) -> dict[str, int]:
+    """``hlo_op_census`` of ``jitted.lower(*args).compile()``. ``kwargs``
+    are forwarded to ``hlo_op_census`` (e.g. ``ops=...``)."""
+    compiled = jitted.lower(*args).compile()
+    return hlo_op_census(compiled.as_text(), **kwargs)
 
 
 class StepTimer:

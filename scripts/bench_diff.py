@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Diff two bench result files (BENCH_r*.json) and flag regressions.
+
+Usage::
+
+    python scripts/bench_diff.py BENCH_r05.json BENCH_r06.json
+    python scripts/bench_diff.py --tolerance 0.05 old.json new.json
+
+Compares every numeric metric present in both files. A metric has
+REGRESSED when it moves in its bad direction (throughput down, latency /
+op-count up) by more than its tolerance — the larger recorded ``spread``
+of the two runs when one exists (benches record run-to-run relative
+spread next to gated metrics), else ``--tolerance`` (default 2%).
+
+Keys listed under ``tunnel_bound_keys`` in either file are measurements
+of the benchmarking transport, not of the system (EVAL_PROTOCOL.md) —
+their regressions are ANNOTATED but never fail the diff. Exit status is
+1 iff a non-tunnel-bound metric regressed; stdlib only, no repo imports,
+so it runs anywhere the jsons land.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# metric -> its recorded run-to-run spread key, where the bench doesn't
+# follow the "<prefix>_steps_per_s" / "<prefix>_spread" convention
+SPREAD_KEY = {
+    "value": "flagship_spread",
+    "idle_uniform_steps_per_s": "idle_spread",
+    "pallas_off_steps_per_s": "idle_spread",
+    "flagship_under_ingest_steps_per_s": "under_ingest_spread",
+}
+
+# substrings marking metrics where UP is the bad direction
+_LOWER_BETTER = ("_ms", "_fusions", "_convs", "_copies", "fusions",
+                 "spread")
+# keys that are configuration echoes / identities, not metrics
+_SKIP = ("_chain_k", "_vs_", "vs_baseline", "ring_capacity",
+         "flagship_batch", "concurrent_writers", "peak_flops", "n", "rc",
+         "flops_per_step")
+
+
+def _parsed(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    return doc.get("parsed", doc) if isinstance(doc, dict) else {}
+
+
+def _lower_is_better(key: str) -> bool:
+    return any(tag in key for tag in _LOWER_BETTER)
+
+
+def _skipped(key: str) -> bool:
+    return key in _SKIP or any(tag in key for tag in _SKIP if tag != "n")
+
+
+def _spread_for(key: str, a: dict, b: dict) -> float | None:
+    sk = SPREAD_KEY.get(key)
+    if sk is None and key.endswith("_steps_per_s"):
+        sk = key[: -len("_steps_per_s")] + "_spread"
+    if sk is None:
+        return None
+    vals = [d[sk] for d in (a, b) if isinstance(d.get(sk), (int, float))]
+    return max(vals) if vals else None
+
+
+def _flatten(d: dict, prefix: str = "") -> dict:
+    """ingest_curve-style nests become dotted keys; each nested dict's
+    own ``spread`` rides along under its dotted name."""
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, f"{key}."))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[key] = float(v)
+    return out
+
+
+def diff(a: dict, b: dict, tolerance: float):
+    """-> (rows, failed). Each row: (key, old, new, rel_delta, tol,
+    status) with status in {ok, improved, regressed, tunnel-bound}."""
+    tunnel = set(a.get("tunnel_bound_keys", []) or [])
+    tunnel |= set(b.get("tunnel_bound_keys", []) or [])
+    fa, fb = _flatten(a), _flatten(b)
+    rows, failed = [], False
+    for key in sorted(fa.keys() & fb.keys()):
+        if _skipped(key) or key.endswith(".spread"):
+            continue
+        old, new = fa[key], fb[key]
+        if key.endswith("spread"):
+            continue
+        tol = _spread_for(key, a, b)
+        if tol is None:
+            # nested curves record spread alongside the metric
+            tol = fa.get(key.rsplit(".", 1)[0] + ".spread")
+        if tol is None:
+            tol = tolerance
+        delta = (new - old) / abs(old) if old else (0.0 if new == old
+                                                    else float("inf"))
+        bad = -delta if _lower_is_better(key) else delta
+        if bad < -tol:
+            root = key.split(".", 1)[0]
+            if root in tunnel or key in tunnel:
+                status = "tunnel-bound"
+            else:
+                status, failed = "regressed", True
+        elif bad > tol:
+            status = "improved"
+        else:
+            status = "ok"
+        rows.append((key, old, new, delta, tol, status))
+    return rows, failed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", help="baseline BENCH_r*.json")
+    ap.add_argument("new", help="candidate BENCH_r*.json")
+    ap.add_argument("--tolerance", type=float, default=0.02,
+                    help="relative tolerance for metrics with no "
+                         "recorded spread (default 0.02)")
+    ap.add_argument("--all", action="store_true",
+                    help="print every compared metric, not just moves")
+    args = ap.parse_args(argv)
+
+    rows, failed = diff(_parsed(args.old), _parsed(args.new),
+                        args.tolerance)
+    if not rows:
+        print("no shared numeric metrics to compare")
+        return 2
+
+    width = max(len(r[0]) for r in rows)
+    marks = {"regressed": "!!", "tunnel-bound": "~~", "improved": "++",
+             "ok": "  "}
+    shown = 0
+    for key, old, new, delta, tol, status in rows:
+        if status == "ok" and not args.all:
+            continue
+        shown += 1
+        note = " (tunnel-bound: informational, never gates)" \
+            if status == "tunnel-bound" else ""
+        print(f"{marks[status]} {key:<{width}}  {old:>12.4g} -> "
+              f"{new:>12.4g}  {delta:+8.2%} (tol {tol:.2%}) "
+              f"{status}{note}")
+    if shown == 0:
+        print(f"all {len(rows)} shared metrics within tolerance")
+    print(f"\n{len(rows)} metrics compared; "
+          f"{sum(r[5] == 'regressed' for r in rows)} regressed, "
+          f"{sum(r[5] == 'tunnel-bound' for r in rows)} tunnel-bound, "
+          f"{sum(r[5] == 'improved' for r in rows)} improved")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

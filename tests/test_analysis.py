@@ -203,6 +203,24 @@ def test_purity_local_alias_resolves_to_kernel():
     assert "purity.host-rng" in rules(findings)
 
 
+def test_purity_gated_alias_lints_both_branches():
+    """``train_fn = plane_fn if gate else tree_fn`` (the stacked/donated
+    step builders' static gate) must make BOTH candidate bodies roots."""
+    findings = purity.check_sources([src("""
+        import jax, time
+        import numpy as np
+
+        def build(use_plane):
+            def plane_fn(x):
+                return np.asarray(x)
+            def tree_fn(x):
+                return x + time.time()
+            train_fn = plane_fn if use_plane else tree_fn
+            return jax.jit(train_fn)
+    """)])
+    assert rules(findings) == {"purity.host-sync", "purity.time"}
+
+
 def test_purity_rng_and_item_decorated():
     findings = purity.check_sources([src("""
         import jax, numpy as np

@@ -1,0 +1,172 @@
+"""Scheduled-op-count ratchet (PERF.md §3/§4).
+
+CPU XLA schedules ~one dispatch per surviving HLO op, so the compiled-HLO
+op census is the denominator of the per-step cost model: every op that
+survives here is paid on every grad step, forever. These budgets are
+RATCHETS — measured from the post-surgery programs with small headroom,
+tightened whenever the count drops, never loosened without a PERF.md
+entry explaining what bought the regression back.
+
+Pre-surgery baselines (r5 seed), for scale:
+
+- fused flagship chain body:  95 fusions / 21 convolutions / 28 copies
+- b32 host-batch train step: 116 fusions / 14 convolutions / 17 copies
+- R2D2 train program:        174 fusions / 16 convolutions / 73 copies
+
+The R2D2 conv count must also be INDEPENDENT of the sequence length:
+the time-batched torso (models/qnet.py ``stacked_r2d2_features``) runs
+the conv stack once over all [B·(T+1)] frames for both nets, so T only
+changes tensor shapes, never the op count. The in-scan reference paid
+four conv chains (online/target × burn/window) whose count scaled with
+how XLA chose to unroll.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bench import fused_train_census, r2d2_train_census
+from distributed_deep_q_tpu.config import (
+    Config, NetConfig, ReplayConfig, TrainConfig)
+
+# budget = (fusions, convolutions, copies); census must be <= elementwise
+FUSED_BODY_BUDGET = (60, 12, 8)     # acceptance bar; measured 60/8/6
+B32_STEP_BUDGET = (125, 8, 6)       # measured 117/8/3
+R2D2_PROGRAM_BUDGET = (215, 8, 55)  # measured 202/8/51
+
+
+def _assert_within(census, budget, label):
+    assert census is not None, f"{label}: census helper returned None"
+    got = (census["fusion"], census["convolution"], census["copy"])
+    assert got[0] <= budget[0] and got[1] <= budget[1] \
+        and got[2] <= budget[2], (
+            f"{label}: scheduled-op census {got} exceeds ratchet "
+            f"(fusions, convolutions, copies) <= {budget} — if this is a "
+            f"deliberate trade, re-measure and document it in PERF.md")
+
+
+def _transition_config():
+    cfg = Config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.dp = 1
+    cfg.net = NetConfig(kind="nature_cnn", num_actions=6, dueling=True,
+                        compute_dtype="bfloat16", frame_shape=(84, 84))
+    cfg.train = TrainConfig(double_dqn=True, target_update_period=2500)
+    cfg.replay = ReplayConfig(capacity=1024, batch_size=32, n_step=3,
+                              prioritized=True, device_per=True,
+                              write_chunk=64, fused_chain=2)
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def transition_solver():
+    """Flagship-shaped transition solver (84×84, bf16, dueling, double,
+    batch 32) shared by the plain-step and fused-chain censuses."""
+    from distributed_deep_q_tpu.solver import Solver
+
+    return Solver(_transition_config())
+
+
+def test_b32_train_step_budget(transition_solver):
+    """Plain host-batch b32 step: whole-module scheduled census."""
+    from distributed_deep_q_tpu.profiling import hlo_op_census
+
+    solver = transition_solver
+    B = 32
+    batch = {
+        "obs": jnp.zeros((B, 84, 84, 4), jnp.uint8),
+        "next_obs": jnp.zeros((B, 84, 84, 4), jnp.uint8),
+        "action": jnp.zeros((B,), jnp.int32),
+        "reward": jnp.zeros((B,), jnp.float32),
+        "discount": jnp.zeros((B,), jnp.float32),
+        "weight": jnp.ones((B,), jnp.float32),
+    }
+    text = solver.learner._train_step.lower(
+        solver.state, batch).compile().as_text()
+    _assert_within(hlo_op_census(text), B32_STEP_BUDGET, "b32 train step")
+
+
+def test_fused_chain_body_budget(transition_solver):
+    """Fused flagship chain: per-grad-step scan-body census — the
+    tentpole acceptance bar (<= 60 fusions / 12 convs / 8 copies, from
+    95/21/28). Programs are built (not executed) so the census pays one
+    compile, exactly the artifact bench.py's census fields measure."""
+    from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
+
+    solver = transition_solver
+    cfg = solver.config
+    replay = DevicePERFrameReplay(cfg.replay, solver.mesh, (84, 84),
+                                  stack=4, gamma=cfg.train.gamma, seed=0,
+                                  write_chunk=64)
+    rng = np.random.default_rng(0)
+    for i in range(300):
+        replay.add(rng.integers(0, 255, (84, 84), dtype=np.uint8),
+                   int(rng.integers(6)), float(rng.standard_normal()),
+                   done=(i % 9 == 8))
+    replay.flush()
+    chain = 2
+    spec = (replay.slot_cap, replay.slot_pad, replay.rowb,
+            replay._row_len, replay.stack, replay.n_step, replay.gamma,
+            tuple(replay.frame_shape),
+            cfg.replay.batch_size // replay.num_shards,
+            float(cfg.replay.priority_alpha),
+            float(cfg.replay.priority_eps),
+            replay.num_shards, replay._interpret)
+    solver._dp_spec, solver._dp_spec_replay = spec, replay
+    solver.learner._device_per_steps[(spec, chain)] = \
+        solver.learner._build_device_per_step(spec, chain)
+    census = fused_train_census(solver, replay, chain)
+    _assert_within(census, FUSED_BODY_BUDGET, "fused chain body")
+
+
+@pytest.fixture(scope="module")
+def r2d2_solver():
+    from distributed_deep_q_tpu.parallel.sequence_learner import (
+        SequenceSolver)
+
+    hw, stack, lstm = (36, 36), 4, 16
+    cfg = Config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.dp = 1
+    cfg.net = NetConfig(kind="r2d2", num_actions=6, frame_shape=hw,
+                        stack=stack, lstm_size=lstm,
+                        compute_dtype="float32")
+    cfg.replay = ReplayConfig(batch_size=8, sequence_length=16, burn_in=4)
+    cfg.train = TrainConfig(double_dqn=True, target_update_period=2500)
+    return SequenceSolver(cfg, obs_dim=int(np.prod(hw)))
+
+
+def _r2d2_batch(solver, seq_len):
+    cfg = solver.config
+    b, lstm = cfg.replay.batch_size, cfg.net.lstm_size
+    hw, stack = tuple(cfg.net.frame_shape), cfg.net.stack
+    T = seq_len + cfg.replay.burn_in
+    return {
+        "obs": jnp.zeros((b, T + 1) + hw + (stack,), jnp.uint8),
+        "action": jnp.zeros((b, T), jnp.int32),
+        "reward": jnp.zeros((b, T), jnp.float32),
+        "discount": jnp.zeros((b, T), jnp.float32),
+        "mask": jnp.ones((b, T), jnp.float32),
+        "weight": jnp.ones((b,), jnp.float32),
+        "init_c": jnp.zeros((b, lstm), jnp.float32),
+        "init_h": jnp.zeros((b, lstm), jnp.float32),
+    }
+
+
+def test_r2d2_train_program_budget(r2d2_solver):
+    census = r2d2_train_census(
+        r2d2_solver, _r2d2_batch(r2d2_solver, seq_len=16))
+    _assert_within(census, R2D2_PROGRAM_BUDGET, "r2d2 train program")
+
+
+def test_r2d2_conv_count_independent_of_t(r2d2_solver):
+    """Halving the train window must not change the scheduled conv
+    count — the torso is time-batched, so T is a shape, not an op."""
+    c16 = r2d2_train_census(r2d2_solver, _r2d2_batch(r2d2_solver, 16))
+    c8 = r2d2_train_census(r2d2_solver, _r2d2_batch(r2d2_solver, 8))
+    assert c16 is not None and c8 is not None
+    assert c16["convolution"] == c8["convolution"], (
+        "R2D2 scheduled conv count changed with sequence length: "
+        f"T=20 -> {c16['convolution']}, T=12 -> {c8['convolution']}")

@@ -1,0 +1,208 @@
+"""Numerical pins for the op-count surgery (PERF.md §3/§4).
+
+Every rewritten program is pinned against the program it replaced:
+
+- the stacked-weight triple Q-forward vs three separate module applies
+- the donated fused step vs the same step compiled without donation
+  (donation is an aliasing contract — it must never change values)
+- the plane-carry fused chain body vs the tree-carry body
+- the time-batched R2D2 torso (burn-in included) vs the module-apply
+  in-scan reference, at the CPU bench shapes
+
+Bitwise where the two programs are the same math in the same order
+(donation); tight-atol where a rewrite legitimately reorders conv/reduce
+lanes (stacked batching changes the batch shape XLA reduces over).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_deep_q_tpu.config import (
+    Config, NetConfig, ReplayConfig, TrainConfig)
+from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
+
+
+def _filled_dev_replay(solver, cfg, seed=0, n=300):
+    dev = DevicePERFrameReplay(cfg.replay, solver.mesh, (36, 36), stack=4,
+                               gamma=0.99, seed=seed, write_chunk=16)
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        dev.add(rng.integers(0, 255, (36, 36), dtype=np.uint8),
+                int(rng.integers(4)), float(rng.standard_normal()),
+                done=(i % 9 == 8))
+    dev.flush()
+    return dev
+
+
+def _transition_cfg(stack_forwards="auto", alpha=0.0):
+    cfg = Config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.dp = 2
+    cfg.net = NetConfig(kind="nature_cnn", num_actions=4,
+                        frame_shape=(36, 36))
+    cfg.train.stack_forwards = stack_forwards
+    cfg.replay = ReplayConfig(capacity=512, batch_size=16, n_step=2,
+                              prioritized=True, priority_alpha=alpha,
+                              device_per=True, write_chunk=16,
+                              fused_chain=2)
+    return cfg
+
+
+@pytest.mark.parametrize("double", [True, False])
+def test_stacked_triple_forward_matches_separate_applies(double):
+    """``stacked_q_forwards`` == the three module applies it replaces.
+    The stacked path batches both nets (and both obs sets) through one
+    conv stack, which changes the shapes XLA reduces over — tight atol,
+    not bitwise."""
+    from distributed_deep_q_tpu.models.qnet import (
+        build_qnet, init_params, stacked_q_forwards)
+
+    net = NetConfig(kind="nature_cnn", num_actions=4, frame_shape=(36, 36),
+                    dueling=True)
+    module = build_qnet(net)
+    params = init_params(module, net, 0)
+    target = init_params(module, net, 1)
+
+    def apply_fn(p, o):
+        return module.apply({"params": p}, o)
+
+    rng = np.random.default_rng(2)
+    obs = jnp.asarray(rng.integers(0, 255, (16, 36, 36, 4), np.uint8))
+    nobs = jnp.asarray(rng.integers(0, 255, (16, 36, 36, 4), np.uint8))
+
+    q, q_no, q_nt = stacked_q_forwards(apply_fn, params, target, obs,
+                                       nobs, double)
+    ref_q = apply_fn(params, obs)
+    ref_nt = apply_fn(target, nobs)
+    np.testing.assert_allclose(q, ref_q, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(q_nt, ref_nt, rtol=1e-5, atol=1e-5)
+    if double:
+        ref_no = apply_fn(params, nobs)
+        np.testing.assert_allclose(q_no, ref_no, rtol=1e-5, atol=1e-5)
+    else:
+        assert q_no is None
+
+
+def test_donated_step_matches_undonated():
+    """Donation is a buffer-aliasing contract, not a program change: the
+    fused chained step must produce bit-identical states and priorities
+    with donation disabled."""
+    from distributed_deep_q_tpu.solver import Solver
+
+    def build(donate):
+        cfg = _transition_cfg()
+        solver = Solver(cfg)
+        replay = _filled_dev_replay(solver, cfg)
+        spec = (replay.slot_cap, replay.slot_pad, replay.rowb,
+                replay._row_len, replay.stack, replay.n_step, replay.gamma,
+                tuple(replay.frame_shape),
+                cfg.replay.batch_size // replay.num_shards,
+                float(cfg.replay.priority_alpha),
+                float(cfg.replay.priority_eps),
+                replay.num_shards, replay._interpret)
+        solver.learner._device_per_steps[(spec, 2)] = \
+            solver.learner._build_device_per_step(spec, 2, donate=donate)
+        return solver, replay
+
+    sa, da = build(donate=True)
+    sb, db = build(donate=False)
+    for _ in range(2):
+        sa.train_steps_device_per(da, chain=2)
+        sb.train_steps_device_per(db, chain=2)
+    jax.block_until_ready(sa.state.params)
+    jax.block_until_ready(sb.state.params)
+    for xa, xb in zip(jax.tree.leaves(sa.state), jax.tree.leaves(sb.state)):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+    np.testing.assert_array_equal(np.asarray(da.dstate.prio),
+                                  np.asarray(db.dstate.prio))
+
+
+def test_plane_body_matches_tree_body():
+    """The plane-carry scan body (stacked forward + flat fused Adam +
+    in-plane target refresh) vs the tree-carry body it replaced. α=0
+    keeps sampling independent of the ulp-level priority differences the
+    reordered reductions introduce; the states then agree to tight atol
+    (flat vs per-leaf grad-norm reduction, lr folded into the Adam
+    denominator — both sub-ulp per step)."""
+    from distributed_deep_q_tpu.solver import Solver
+
+    def build(stack_forwards):
+        cfg = _transition_cfg(stack_forwards=stack_forwards)
+        solver = Solver(cfg)
+        return solver, _filled_dev_replay(solver, cfg)
+
+    sa, da = build("on")    # plane body
+    sb, db = build("off")   # tree body (reference)
+    for _ in range(2):
+        sa.train_steps_device_per(da, chain=2)
+        sb.train_steps_device_per(db, chain=2)
+    jax.block_until_ready(sa.state.params)
+    jax.block_until_ready(sb.state.params)
+    leaves_a = jax.tree.leaves(sa.state)
+    leaves_b = jax.tree.leaves(sb.state)
+    assert len(leaves_a) == len(leaves_b)
+    for xa, xb in zip(leaves_a, leaves_b):
+        np.testing.assert_allclose(np.asarray(xa, np.float32),
+                                   np.asarray(xb, np.float32),
+                                   rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(da.dstate.prio),
+                               np.asarray(db.dstate.prio),
+                               rtol=1e-4, atol=1e-5)
+
+
+def _r2d2_solver(stack_forwards):
+    from distributed_deep_q_tpu.parallel.sequence_learner import (
+        SequenceSolver)
+
+    hw, stack, lstm = (36, 36), 4, 16
+    cfg = Config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.dp = 1
+    cfg.net = NetConfig(kind="r2d2", num_actions=6, frame_shape=hw,
+                        stack=stack, lstm_size=lstm,
+                        compute_dtype="float32")
+    cfg.replay = ReplayConfig(batch_size=8, sequence_length=16, burn_in=4)
+    cfg.train.stack_forwards = stack_forwards
+    return SequenceSolver(cfg, obs_dim=int(np.prod(hw)))
+
+
+def test_r2d2_time_batched_torso_matches_in_scan_reference():
+    """The time-batched stacked torso path (one conv pass over all
+    [B·(T+1)] frames, burn-in included, both nets) vs the module-apply
+    reference (four conv chains) — same batch, same init, one full train
+    step each, at the CPU bench shapes. Pins loss, per-sequence
+    priorities, and the post-step parameters."""
+    b, seq, burn, lstm = 8, 16, 4, 16
+    T = seq + burn
+    sa = _r2d2_solver("on")
+    sb = _r2d2_solver("off")
+
+    rng = np.random.default_rng(5)
+    mask = np.ones((b, T), np.float32)
+    mask[0, -6:] = 0.0          # one truncated sequence
+    discount = np.full((b, T), 0.99, np.float32)
+    discount[1, 7] = 0.0        # one episode cut inside the window
+    batch = {
+        "obs": rng.integers(0, 255, (b, T + 1, 36, 36, 4), np.uint8),
+        "action": rng.integers(0, 6, (b, T)).astype(np.int32),
+        "reward": rng.standard_normal((b, T)).astype(np.float32),
+        "discount": discount,
+        "mask": mask,
+        "weight": np.linspace(0.5, 1.0, b).astype(np.float32),
+        "init_c": rng.standard_normal((b, lstm)).astype(np.float32) * 0.1,
+        "init_h": rng.standard_normal((b, lstm)).astype(np.float32) * 0.1,
+    }
+    ma = sa.train_step(dict(batch))
+    mb = sb.train_step(dict(batch))
+    np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ma["td_abs"]),
+                               np.asarray(mb["td_abs"]),
+                               rtol=1e-4, atol=1e-5)
+    for xa, xb in zip(jax.tree.leaves(sa.state.params),
+                      jax.tree.leaves(sb.state.params)):
+        np.testing.assert_allclose(np.asarray(xa), np.asarray(xb),
+                                   rtol=1e-5, atol=1e-5)
