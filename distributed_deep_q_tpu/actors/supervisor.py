@@ -167,6 +167,13 @@ class _ActorComms:
         self._local_stop = threading.Event()
         self._stall_budget = float(cfg.actors.env_stall_budget)
         self._watermark = time.monotonic()
+        # staleness guard (ISSUE 5): the newest published θ version rides
+        # back on every flush reply (note_published); once the pulled
+        # version trails it by more than max_param_lag, the next
+        # maybe_pull blocks on a fresh pull regardless of the period
+        self._max_lag = int(getattr(cfg.actors, "max_param_lag", 0))
+        self._published = -1
+        self.lag_blocks = 0  # pulls forced by the staleness guard
         hb = cfg.actors.heartbeat_period
         if hb:
             threading.Thread(target=self._beat, args=(float(hb),),
@@ -223,18 +230,42 @@ class _ActorComms:
     def close(self) -> None:
         self._local_stop.set()
 
+    def touch(self) -> None:
+        """Advance the liveness watermark for INTENTIONAL waits — the
+        resilient client calls this while pacing to credits or waiting
+        out a SHED, so a backpressured actor reads as alive, not hung."""
+        self._watermark = time.monotonic()
+
+    def note_published(self, version) -> None:
+        """Record the newest θ version the server advertised on a flush
+        reply (env-loop only; plain store, no lock needed)."""
+        if version is not None and int(version) > self._published:
+            self._published = int(version)
+
+    def stale(self) -> bool:
+        """True when the pulled θ trails the published version by more
+        than ``actors.max_param_lag`` — the actor must not act again
+        until a fresh pull lands (bounded staleness, IMPACT-style)."""
+        return (self._max_lag > 0 and self._version >= 0
+                and self._published - self._version > self._max_lag)
+
     def maybe_pull(self, steps: int) -> None:
         self._watermark = time.monotonic()  # loop progress (beat gate)
-        if steps == 0 or (steps + self._phase) % self._period == 0:
-            t0 = time.perf_counter()
-            version, weights = self._client.get_params(
-                have_version=self._version)
-            # time the full round trip incl. installing fresh weights —
-            # that is the latency the env loop actually pays
-            if weights is not None:
-                self._qnet.set_weights(weights)
-                self._version = version
-            self._pull_ms.append(1e3 * (time.perf_counter() - t0))
+        due = steps == 0 or (steps + self._phase) % self._period == 0
+        stale = self.stale()
+        if not (due or stale):
+            return
+        if stale and not due:
+            self.lag_blocks += 1
+        t0 = time.perf_counter()
+        version, weights = self._client.get_params(
+            have_version=self._version)
+        # time the full round trip incl. installing fresh weights —
+        # that is the latency the env loop actually pays
+        if weights is not None:
+            self._qnet.set_weights(weights)
+            self._version = version
+        self._pull_ms.append(1e3 * (time.perf_counter() - t0))
 
     def drain_telemetry(self) -> dict[str, np.ndarray]:
         """Buffered latency samples as ``tm_*`` wire arrays (cleared on
@@ -300,6 +331,7 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
         policy=RetryPolicy(base_delay=cfg.actors.rpc_retry_base,
                            max_delay=cfg.actors.rpc_retry_max,
                            deadline=cfg.actors.rpc_retry_deadline),
+        timeout=cfg.actors.rpc_call_timeout,
         should_abort=stop_event.is_set,
         seed=cfg.train.seed + 31337 * (gid + 1))
     # announce a fresh writer on this stream id: the server seals the
@@ -353,7 +385,8 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
         step_ms = env.drain_step_ms()
         if step_ms:
             payload["tm_env_step_ms"] = np.asarray(step_ms, np.float32)
-        client.add_transitions(**payload)
+        resp = client.add_transitions(**payload)
+        comms.note_published(resp.get("params_version"))
         for v in chunk.values():
             v.clear()
         ep_returns.clear()
@@ -365,6 +398,9 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     # θ refresh over the RPC boundary (SURVEY §5.8) + background liveness
     # beat, independent of env stepping
     comms = _ActorComms(cfg, client, qnet, rng)
+    # credit throttling / SHED waits advance the liveness watermark: a
+    # backpressured actor is waiting on purpose, not wedged
+    client.on_backpressure = comms.touch
     try:
         while not stop_event.is_set():
             if max_env_steps and steps >= max_env_steps:
@@ -463,7 +499,8 @@ def _recurrent_actor_loop(cfg: Config, env, qnet, client, rng, eps: float,
         step_ms = getattr(env, "drain_step_ms", lambda: [])()
         if step_ms:
             payload["tm_env_step_ms"] = np.asarray(step_ms, np.float32)
-        client.add_transitions(**payload)
+        resp = client.add_transitions(**payload)
+        comms.note_published(resp.get("params_version"))
         seqs.clear()
         ep_returns.clear()
         episodes = 0
@@ -474,6 +511,7 @@ def _recurrent_actor_loop(cfg: Config, env, qnet, client, rng, eps: float,
     carry = qnet.initial_state(1)
     ep_ret = 0.0
     comms = _ActorComms(cfg, client, qnet, rng)
+    client.on_backpressure = comms.touch
     try:
         while not stop_event.is_set():
             if max_env_steps and steps >= max_env_steps:
@@ -625,14 +663,20 @@ def _bring_up_rpc_plane(cfg: Config, replay):
     ``train.server_snapshot_path`` (stable port when snapshotting — a
     restarted learner must come back where the fleet expects it)."""
     from distributed_deep_q_tpu.rpc import faultinject
+    from distributed_deep_q_tpu.rpc.flowcontrol import FlowConfig
     from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedServer
 
     if cfg.actors.chaos:
         os.environ[faultinject.ENV_VAR] = cfg.actors.chaos
     snap = cfg.train.server_snapshot_path
+    flow = FlowConfig(
+        flush_credit_floor=cfg.actors.flush_credit_floor,
+        staged_high_watermark=cfg.replay.staged_high_watermark,
+        shed_policy=cfg.replay.shed_policy,
+        rss_high_watermark_mb=cfg.replay.rss_high_watermark_mb)
     server = ReplayFeedServer(replay, host=cfg.actors.host,
                               port=cfg.actors.port if snap else 0,
-                              snapshot_path=snap)
+                              snapshot_path=snap, flow=flow)
     host, port = server.address
     sup = ActorSupervisor(cfg, host, port)
     sup.start()
@@ -814,6 +858,9 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                 with timer.phase("dispatch"):
                     m = solver.train_step(batch)
             metrics.count("grad_steps")
+            # feed the flow controller's consumption EWMA: credits granted
+            # to actors track what the learner actually drains per step
+            server.note_consumed(local_batch)
             timer.step_done()
             trace.on_step(gstep)
 
@@ -870,6 +917,8 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     rpc = server.telemetry.robustness_counters()
     summary["rpc_dispatch_errors"] = rpc["dispatch_errors"]
     summary["rpc_duplicate_flushes"] = rpc["duplicate_flushes"]
+    summary["rpc_shed_flushes"] = rpc["shed_flushes"]
+    summary["flow_degraded_trips"] = server.flow_counters()["degraded_trips"]
     summary["solver"] = solver
     summary["replay"] = replay
     return summary
@@ -999,6 +1048,9 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
                 with timer.phase("dispatch"):
                     m = solver.train_step(batch)
             metrics.count("grad_steps")
+            # consumption is denominated in env transitions (what actors
+            # flush), so a sequence batch counts batch × sequence_length
+            server.note_consumed(local_batch * cfg.replay.sequence_length)
             timer.step_done()
 
             if writeback is not None:
@@ -1043,6 +1095,8 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
     rpc = server.telemetry.robustness_counters()
     summary["rpc_dispatch_errors"] = rpc["dispatch_errors"]
     summary["rpc_duplicate_flushes"] = rpc["duplicate_flushes"]
+    summary["rpc_shed_flushes"] = rpc["shed_flushes"]
+    summary["flow_degraded_trips"] = server.flow_counters()["degraded_trips"]
     summary["solver"] = solver
     summary["replay"] = replay
     return summary

@@ -105,6 +105,18 @@ DEFAULT_REGISTRY = LockRegistry(
                                   ("self", "server.telemetry")),
         "duplicate_flushes": Guard("_lock", "ServerTelemetry",
                                    ("self", "server.telemetry")),
+        "shed_flushes":     Guard("_lock", "ServerTelemetry",
+                                  ("self", "server.telemetry")),
+        "actor_sheds":      Guard("_lock", "ServerTelemetry",
+                                  ("self", "server.telemetry")),
+        "conn_timeouts":    Guard("_lock", "ServerTelemetry",
+                                  ("self", "server.telemetry")),
+        # FlowController overload state shares the server's replay_lock so
+        # admission is atomic with the insert it gates
+        "credits":          Guard("replay_lock", "FlowController"),
+        "degraded":         Guard("replay_lock", "FlowController"),
+        "degraded_trips":   Guard("replay_lock", "FlowController"),
+        "shed_total":       Guard("replay_lock", "FlowController"),
         # NOTE deliberately unregistered: ReplayFeedServer.last_seen is a
         # GIL-atomic monotonic stamp dict (single-writer per key, reader
         # tolerates staleness); DeviceStager._err is benign once-set.
@@ -113,6 +125,7 @@ DEFAULT_REGISTRY = LockRegistry(
         "native/__init__.py": {"_lib": "_lock", "_tried": "_lock"},
     },
     files=(
+        "distributed_deep_q_tpu/rpc/flowcontrol.py",
         "distributed_deep_q_tpu/rpc/replay_server.py",
         "distributed_deep_q_tpu/actors/supervisor.py",
         "distributed_deep_q_tpu/replay/staging.py",

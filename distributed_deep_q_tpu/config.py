@@ -80,6 +80,18 @@ class ReplayConfig:
     # this .npz alongside learner checkpoints and restored on
     # train.resume. Default empty = warm-refill, matching the reference
     persist_path: str = ""
+    # overload plane (rpc/flowcontrol.py): staged-but-unflushed rows the
+    # server tolerates before flushes are shed / the watchdog trips
+    # degraded mode. Watermark rows are replay rows, not bytes
+    staged_high_watermark: int = 8192
+    # which flushes the admission controller sheds under overload:
+    # "fair" sheds actors over their fair share of the fleet ingest rate
+    # first, "all" sheds every flush while over the watermark, "none"
+    # disables shedding (credits still throttle)
+    shed_policy: str = "fair"
+    # learner-process RSS bound for the flowcontrol watchdog (0 = RSS
+    # tripwire disabled; staged-depth tripwire is always on)
+    rss_high_watermark_mb: int = 0
 
 
 @dataclass
@@ -209,6 +221,18 @@ class ActorConfig:
     rpc_retry_base: float = 0.05
     rpc_retry_max: float = 2.0
     rpc_retry_deadline: float = 120.0
+    # per-call socket timeout on the actor-side stub: a stalled server
+    # surfaces as a retryable TimeoutError instead of hanging the actor
+    rpc_call_timeout: float = 30.0
+    # staleness guard: an actor whose pulled θ version trails the
+    # published version by more than this many publishes blocks on a
+    # fresh pull before acting (0 disables). The published version rides
+    # back on every add_transitions reply, so the check is free
+    max_param_lag: int = 10
+    # credit-based backpressure floor: the server never grants an actor
+    # fewer than this many rows/second while healthy, so a throttled
+    # fleet keeps trickling instead of livelocking
+    flush_credit_floor: int = 64
     # chaos injection spec for the whole fleet (rpc/faultinject.py), e.g.
     # "drop=0.02,delay=0.05:40,corrupt=0.01,seed=7"; propagated to actor
     # processes via the DDQ_CHAOS env var. Empty = no faults

@@ -13,6 +13,9 @@ runs can prove the retry/dedup/warm-boot machinery absorbs them:
 - ``corrupt``   — flip one byte of an outgoing frame (bit rot; the decode
                   bounds/geometry checks must reject structural damage)
 - ``stall``     — sleep before a receive (server hiccup as seen by peers)
+- ``throttle``  — bandwidth cap in bytes/second on sends (slow link), so
+                  overload can be induced at the transport layer instead
+                  of by fleet sizing (ISSUE 5)
 
 Install programmatically (``install("drop=0.05,seed=1")``) or via the
 ``DDQ_CHAOS`` environment variable, which spawned actor processes inherit —
@@ -53,6 +56,7 @@ class ChaosPlan:
     corrupt: float = 0.0     # P(flip one byte) per send
     stall_p: float = 0.0     # P(sleep before recv)
     stall_ms: float = 50.0   # max stall, uniform [0, stall_ms]
+    throttle: float = 0.0    # bytes/second bandwidth cap on sends (0 = off)
     seed: int = 0
     counters: dict = field(default_factory=dict)
 
@@ -80,7 +84,7 @@ class ChaosPlan:
                     kv[f"{name}_ms"] = float(ms)
             elif name == "seed":
                 kv["seed"] = int(val)
-            elif name in ("drop", "truncate", "corrupt"):
+            elif name in ("drop", "truncate", "corrupt", "throttle"):
                 kv[name] = float(val)
             else:
                 raise ValueError(f"unknown chaos knob {name!r} in {spec!r}")
@@ -146,6 +150,12 @@ class ChaosSocket:
 
     def sendall(self, data) -> None:
         plan = self._plan
+        if plan.throttle > 0:
+            # deterministic bandwidth cap: pay the frame's wire time up
+            # front. Deliberately not probabilistic — a slow link is slow
+            # for every frame, and determinism keeps soak timings stable
+            plan._fire(f"{self._side}/throttle")
+            time.sleep(len(data) / plan.throttle)
         if self._roll(plan.delay_p):
             plan._fire(f"{self._side}/delay")
             time.sleep(plan._rng.random() * plan.delay_ms / 1e3)

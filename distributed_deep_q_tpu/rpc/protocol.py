@@ -9,7 +9,7 @@ receive), no HTTP framing overhead, zero-copy numpy buffer sends.
 A message is a dict[str, ndarray | int | float | bool | str | None]:
 
     u8  magic 0xD9   (frame-boundary guard: a desynced or corrupted stream
-    u8  version 2     is detected HERE, not as a reshape error in dispatch)
+    u8  version 3     is detected HERE, not as a reshape error in dispatch)
     u32 LE  total payload length
     u16 LE  item count
     per item:
@@ -37,7 +37,11 @@ import numpy as np
 MAX_MESSAGE = 1 << 30  # 1 GiB sanity cap
 
 MAGIC = 0xD9
-WIRE_VERSION = 2
+# v3 (ISSUE 5): add_transitions replies grew credit/SHED/params_version
+# fields. Payload encoding is byte-identical to v2 (the new surface is
+# plain dict entries), so v2 frames remain decodable — see ``reframe``.
+WIRE_VERSION = 3
+_COMPAT_PAYLOAD_VERSIONS = (2, 3)
 _HEADER = struct.Struct("<BBI")  # magic, version, payload length
 HEADER_SIZE = _HEADER.size
 
@@ -185,6 +189,33 @@ def _decode(payload: bytes) -> dict[str, Any]:
         raise ProtocolError(
             f"{len(payload) - off} trailing bytes after {count} items")
     return msg
+
+
+def reframe(frame: bytes) -> bytes:
+    """Re-stamp a stored wire frame to the CURRENT protocol version.
+
+    Warm-boot snapshots persist the published θ frame verbatim
+    (``params_wire``); after a version bump that frame would fail the
+    receiver's version check even though the run is otherwise resumable.
+    Payload-compatible versions are re-stamped in place; anything else is
+    a real format change and must fail loudly rather than mis-parse."""
+    if len(frame) < HEADER_SIZE:
+        raise ProtocolError(f"stored frame of {len(frame)} bytes is shorter "
+                            "than a header")
+    magic, version, length = _HEADER.unpack_from(frame)
+    if magic != MAGIC:
+        raise ProtocolError(f"stored frame has bad magic 0x{magic:02x}")
+    if length != len(frame) - HEADER_SIZE:
+        raise ProtocolError(
+            f"stored frame length {length} disagrees with "
+            f"{len(frame) - HEADER_SIZE} payload bytes")
+    if version == WIRE_VERSION:
+        return frame
+    if version not in _COMPAT_PAYLOAD_VERSIONS:
+        raise ProtocolError(
+            f"stored frame speaks wire version {version}; payload format "
+            f"is not compatible with {WIRE_VERSION}")
+    return _HEADER.pack(MAGIC, WIRE_VERSION, length) + frame[HEADER_SIZE:]
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:

@@ -34,8 +34,9 @@ import numpy as np
 
 from distributed_deep_q_tpu.metrics import Histogram
 from distributed_deep_q_tpu.rpc import faultinject
+from distributed_deep_q_tpu.rpc.flowcontrol import FlowConfig, FlowController
 from distributed_deep_q_tpu.rpc.protocol import (
-    ProtocolError, encode, recv_msg, recv_msg_sized, send_msg)
+    ProtocolError, encode, recv_msg, recv_msg_sized, reframe, send_msg)
 
 log = logging.getLogger(__name__)
 
@@ -73,6 +74,12 @@ class ServerTelemetry:
         # dedup absorbed (each one is a prevented double-insert)
         self.dispatch_errors = 0
         self.duplicate_flushes = 0
+        # overload plane: flushes answered with an explicit SHED (total and
+        # per actor — the fleet view of who is being backpressured) and
+        # serve threads reaped by the socket recv/send deadline
+        self.shed_flushes = 0
+        self.actor_sheds: dict[int, int] = {}
+        self.conn_timeouts = 0
 
     def record_dispatch_error(self) -> None:
         with self._lock:
@@ -81,6 +88,17 @@ class ServerTelemetry:
     def record_duplicate_flush(self) -> None:
         with self._lock:
             self.duplicate_flushes += 1
+
+    def record_shed(self, actor_id: int) -> None:
+        with self._lock:
+            self.shed_flushes += 1
+            if actor_id >= 0:
+                self.actor_sheds[actor_id] = \
+                    self.actor_sheds.get(actor_id, 0) + 1
+
+    def record_conn_timeout(self) -> None:
+        with self._lock:
+            self.conn_timeouts += 1
 
     def record_call(self, method: str, ms: float, nbytes: int) -> None:
         with self._lock:
@@ -140,6 +158,8 @@ class ServerTelemetry:
                     self.last_pulled_version.values())
             out["rpc/dispatch_errors"] = self.dispatch_errors
             out["rpc/duplicate_flushes"] = self.duplicate_flushes
+            out["rpc/shed_flushes"] = self.shed_flushes
+            out["rpc/conn_timeouts"] = self.conn_timeouts
             return out
 
     def per_actor_env_steps(self) -> tuple[np.ndarray, np.ndarray]:
@@ -149,12 +169,21 @@ class ServerTelemetry:
                     np.asarray([self.actor_env_steps[i] for i in ids],
                                np.int64))
 
+    def per_actor_sheds(self) -> tuple[np.ndarray, np.ndarray]:
+        with self._lock:
+            ids = sorted(self.actor_sheds)
+            return (np.asarray(ids, np.int64),
+                    np.asarray([self.actor_sheds[i] for i in ids],
+                               np.int64))
+
     def robustness_counters(self) -> dict[str, int]:
         """Locked read of the robustness gauges — summary/verdict paths
         must not read them raw while serve threads increment."""
         with self._lock:
             return {"dispatch_errors": self.dispatch_errors,
-                    "duplicate_flushes": self.duplicate_flushes}
+                    "duplicate_flushes": self.duplicate_flushes,
+                    "shed_flushes": self.shed_flushes,
+                    "conn_timeouts": self.conn_timeouts}
 
 
 class ReplayFeedServer:
@@ -165,12 +194,18 @@ class ReplayFeedServer:
     ERR_LOG_PERIOD = 5.0
 
     def __init__(self, replay, host: str = "127.0.0.1", port: int = 0,
-                 snapshot_path: str = ""):
+                 snapshot_path: str = "", flow: FlowConfig | None = None):
         self.replay = replay
         self.telemetry = ServerTelemetry()
         # RLock: stats/mean_recent_return may be read under an already-held
         # guard (e.g. inside the add_transitions/stats handlers)
         self.replay_lock = threading.RLock()
+        # overload plane: credit ledger + admission controller + watchdog,
+        # sharing replay_lock so admission is atomic with the insert it
+        # gates. Ephemeral by design — credits/rates rebuild within one
+        # EWMA half-life after a warm boot, so it rides in no snapshot
+        self.flow = FlowController(flow or FlowConfig(), self.replay_lock,
+                                   replay)
         self._params_wire: bytes | None = None  # pre-encoded θ frame
         self._params_version = 0
         self._params_lock = threading.Lock()
@@ -202,6 +237,7 @@ class ReplayFeedServer:
         if snapshot_path:
             self._restore(snapshot_path)
 
+        self.flow.start_watchdog()
         self._sock = socket.create_server((host, port))
         self.address = self._sock.getsockname()
         self._stop = threading.Event()
@@ -228,10 +264,25 @@ class ReplayFeedServer:
             self._params_wire = encode(msg)
             return self._params_version
 
+    def _published_version(self) -> int:
+        with self._params_lock:
+            return self._params_version
+
     def mean_recent_return(self, k: int = 100) -> float:
         with self.replay_lock:
             tail = list(self.returns)[-k:]
         return float(np.mean(tail)) if tail else float("nan")
+
+    def note_consumed(self, rows: int) -> None:
+        """Learner-side feed for the credit formula: ``rows`` were sampled
+        for training. Drives consumption-rate-based credits and the
+        ingest-mismatch shed branch; costs one EWMA update per call."""
+        self.flow.note_consumed(rows)
+
+    def flow_counters(self) -> dict:
+        """Locked snapshot of the overload gauges (degraded flag/trips,
+        sheds, consume/ingest rates, per-actor credits)."""
+        return self.flow.counters()
 
     def counters(self) -> dict[str, int]:
         """Locked, mutually consistent read of the ingest counters for
@@ -266,6 +317,7 @@ class ReplayFeedServer:
                 c.close()
             except OSError:
                 pass
+        self.flow.close()
 
     # -- restart survival ---------------------------------------------------
     #
@@ -336,7 +388,10 @@ class ReplayFeedServer:
                            zip(z["flush_ids"], z["flush_seqs"])}
         self._params_version = int(z["params_version"])
         wire = z["params_wire"]
-        self._params_wire = wire.tobytes() if wire.size else None
+        # snapshots persist the θ frame verbatim; re-stamp frames written
+        # by a previous (payload-compatible) wire version so resumed
+        # actors don't reject the pull
+        self._params_wire = reframe(wire.tobytes()) if wire.size else None
         if self.replay is not None and os.path.exists(replay_file):
             load_replay(self.replay, replay_file)
         log.info("warm boot from %s: env_steps=%d replay=%s θ-version=%d",
@@ -371,6 +426,12 @@ class ReplayFeedServer:
 
     def _serve(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # recv/send deadline: a wedged or half-dead peer cannot pin a serve
+        # thread (and its connection slot) forever. Healthy-but-idle actors
+        # heartbeat every ~5 s over this socket, far inside the bound
+        deadline = self.flow.cfg.conn_deadline_s
+        if deadline and deadline > 0:
+            conn.settimeout(deadline)
         conn = faultinject.wrap(conn, side="server")
         with self._conns_lock:
             self._conns.add(conn)
@@ -378,6 +439,12 @@ class ReplayFeedServer:
             while not self._stop.is_set():
                 try:
                     req, nbytes = recv_msg_sized(conn)
+                except TimeoutError as e:
+                    # conn deadline expired mid-recv: reap the thread; a
+                    # live client reconnects through its retry policy
+                    self.telemetry.record_conn_timeout()
+                    self._log_error("conn deadline", e)
+                    return
                 except ProtocolError as e:
                     # desynced/corrupt stream: the frame boundary is gone,
                     # so no error reply is possible — log, count, drop the
@@ -412,6 +479,8 @@ class ReplayFeedServer:
                 self.telemetry.record_call(
                     str(req.get("method")),
                     1e3 * (time.perf_counter() - t0), nbytes)
+        except TimeoutError:
+            self.telemetry.record_conn_timeout()  # deadline expired mid-send
         except (ConnectionError, OSError):
             pass  # actor went away; supervisor handles liveness
         finally:
@@ -426,28 +495,46 @@ class ReplayFeedServer:
             self.last_seen[actor_id] = time.monotonic()
 
         if method == "add_transitions":
+            # row count up front: the admission controller needs it before
+            # any insert happens (sequence batches carry explicit env_steps;
+            # overlapping windows would double-count otherwise)
+            if "init_c" in req:
+                n = int(req.get("env_steps", len(req["action"])))
+            else:
+                n = len(req["action"])
             with self.replay_lock:
                 # idempotent-flush dedup: a resilient client resends a
                 # failed flush with the SAME flush_seq; if the first send
                 # actually landed (ack lost — the ambiguous failure), the
                 # stamp is already recorded and the retry must be a no-op
-                # or replay would hold duplicated transitions
+                # or replay would hold duplicated transitions. Dedup wins
+                # over admission: the data is already in, shedding the
+                # retry would only make the client resend a third time
                 seq = int(req.get("flush_seq", -1))
                 if seq >= 0 and actor_id >= 0 \
                         and seq <= self._flush_seq.get(actor_id, -1):
                     self.telemetry.record_duplicate_flush()
                     return {"ok": True, "duplicate": True,
-                            "env_steps": self.env_steps}
+                            "env_steps": self.env_steps,
+                            "credits": self.flow.grant(actor_id),
+                            "params_version": self._published_version()}
+                admitted, retry_ms = self.flow.admit(actor_id, n)
+                if not admitted:
+                    # explicit SHED — never a silent drop. The seq stays
+                    # unstamped, so the client re-sends the SAME flush
+                    # after retry_after_ms and it lands exactly once when
+                    # the backlog clears (PR 2 zero-loss contract holds)
+                    self.telemetry.record_shed(actor_id)
+                    return {"ok": False, "shed": True,
+                            "retry_after_ms": retry_ms,
+                            "credits": self.flow.grant(actor_id),
+                            "params_version": self._published_version()}
                 if "init_c" in req:  # R2D2 sequence batch → SequenceReplay
-                    # leading dim = sequence count; env-step accounting comes
-                    # from the actor (overlapping windows would double-count)
                     self.replay.add_batch(
                         {k: req[k] for k in
                          ("obs", "action", "reward", "discount", "mask",
                           "init_c", "init_h")})
-                    n = int(req.get("env_steps", len(req["action"])))
                 elif "frame" in req:  # pixel stream → frame/device ring
-                    n = len(req["action"])
                     batch = {k: req[k] for k in
                              ("frame", "action", "reward", "done", "boundary")
                              if k in req}
@@ -456,7 +543,6 @@ class ReplayFeedServer:
                     else:
                         self.replay.add_batch(batch)
                 else:  # explicit n-step transitions (vector envs)
-                    n = len(req["action"])
                     self.replay.add_batch(
                         {k: req[k] for k in
                          ("obs", "action", "reward", "next_obs", "discount")})
@@ -470,9 +556,14 @@ class ReplayFeedServer:
                 # error dict; only a clean landing may absorb its retries)
                 if seq >= 0 and actor_id >= 0:
                     self._flush_seq[actor_id] = seq
+                self.flow.on_ingest(actor_id, n)
+                credits = self.flow.grant(actor_id)
                 total = self.env_steps
             self.telemetry.on_transitions(actor_id, n, req)
-            return {"ok": True, "env_steps": total}
+            # credits + published θ version ride every reply: the client's
+            # token bucket and staleness guard get their inputs for free
+            return {"ok": True, "env_steps": total, "credits": credits,
+                    "params_version": self._published_version()}
 
         if method == "get_params":
             with self._params_lock:
@@ -516,6 +607,9 @@ class ReplayFeedServer:
             ids, steps = self.telemetry.per_actor_env_steps()
             out["actor_ids"] = ids
             out["actor_env_steps"] = steps
+            shed_ids, shed_counts = self.telemetry.per_actor_sheds()
+            out["shed_actor_ids"] = shed_ids
+            out["shed_counts"] = shed_counts
             return out
 
         return {"error": f"unknown method {method!r}"}
@@ -538,6 +632,12 @@ class ReplayFeedServer:
                 if pending is not None:
                     out["queue/staged_rows"] = int(pending())
         out["fleet/actors_seen"] = len(self.last_seen)
+        fc = self.flow.counters()
+        out["flow/degraded"] = fc["degraded"]
+        out["flow/degraded_trips"] = fc["degraded_trips"]
+        out["flow/shed_total"] = fc["shed_total"]
+        out["flow/consume_rate"] = round(fc["consume_rate"], 3)
+        out["flow/ingest_rate"] = round(fc["ingest_rate"], 3)
         return out
 
 
@@ -607,6 +707,7 @@ class ReplayFeedClient:
 
     def close(self) -> None:
         try:
-            self._sock.close()
+            if self._sock is not None:  # dropped after a failed call
+                self._sock.close()
         except OSError:
             pass

@@ -21,6 +21,14 @@ the absorption:
     failure mode (frame sent, ack lost) can never double-insert into
     replay.
 
+Overload is NOT failure (ISSUE 5): the server may answer a flush with an
+explicit ``SHED`` (admission control) and every reply carries a credit
+grant (rows/second allowance). ``add_transitions`` honors both — a
+``TokenBucket`` paces the flush cadence to the granted rate, and a shed
+flush is re-sent with the SAME ``flush_seq`` after the server's
+``retry_after_ms`` hint, distinct from the transport-failure retry path
+(no socket drop, no reconnect, no deadline burn).
+
 Nothing here owns policy about *fatal* errors: once the deadline lapses
 the last exception propagates and the supervisor's respawn path takes
 over, exactly as before this layer existed.
@@ -36,6 +44,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from distributed_deep_q_tpu.rpc.flowcontrol import TokenBucket
 from distributed_deep_q_tpu.rpc.protocol import ProtocolError
 
 log = logging.getLogger(__name__)
@@ -112,6 +121,18 @@ class ResilientReplayFeedClient:
         self._flush_seq = 0
         self.retries = 0      # attempts beyond the first, all methods
         self.gave_up = 0      # deadline exhaustions (error propagated)
+        # overload plane: credit-fed flush pacer (unlimited until the
+        # server's first grant — zero cost against a grantless server),
+        # shed/throttle accounting, and the newest θ version the server
+        # advertised on a flush reply (feeds the staleness guard)
+        self.bucket = TokenBucket()
+        self.sheds = 0          # flushes answered with SHED, then re-sent
+        self.throttled_s = 0.0  # total seconds spent pacing to credits
+        self.params_version = -1
+        # optional liveness hook, called while waiting out backpressure —
+        # the supervisor wires this to its progress watermark so a long
+        # throttle reads as intentional waiting, not a hang
+        self.on_backpressure: Callable[[], None] | None = None
 
     @classmethod
     def connect(cls, host: str, port: int, actor_id: int = 0,
@@ -164,18 +185,65 @@ class ResilientReplayFeedClient:
 
     def add_transitions(self, **batch: Any) -> dict[str, Any]:
         """Idempotent flush: stamp a fresh ``flush_seq``, resend the SAME
-        stamp on every retry so the server can dedup ambiguous resends."""
+        stamp on every retry so the server can dedup ambiguous resends.
+
+        Honors the overload plane on both sides of the send: the token
+        bucket paces the flush to the last credit grant BEFORE the bytes
+        move, and a ``SHED`` reply re-stages the same payload (same seq)
+        after the server's ``retry_after_ms`` hint — backpressure is
+        explicit cooperation, not a transport fault, so it neither drops
+        the socket nor burns the retry deadline."""
+        rows = int(batch.get("env_steps", 0)) or \
+            len(batch.get("action", ())) or 1
+        wait = self.bucket.reserve(rows)
+        if wait > 0.0:
+            self.throttled_s += wait
+            self._sleep_backpressure(wait)
         self._flush_seq += 1
         seq = self._flush_seq
-        resp = self._run(
-            "add_transitions",
-            lambda: self._client.call("add_transitions",
-                                      flush_seq=seq, **batch))
-        if resp.get("error"):
-            # the server rejected the payload (malformed batch, not a
-            # transport fault) — surface it loudly; retrying cannot help
-            raise RPCError(f"add_transitions rejected: {resp['error']}")
-        return resp
+        while True:
+            resp = self._run(
+                "add_transitions",
+                lambda: self._client.call("add_transitions",
+                                          flush_seq=seq, **batch))
+            if resp.get("error"):
+                # the server rejected the payload (malformed batch, not a
+                # transport fault) — surface it loudly; retrying cannot help
+                raise RPCError(f"add_transitions rejected: {resp['error']}")
+            self._note_reply(resp)
+            if resp.get("shed"):
+                self.sheds += 1
+                delay = max(float(resp.get("retry_after_ms", 100)), 10.0) \
+                    / 1e3
+                # decorrelate the fleet's re-sends a little
+                delay *= 1.0 + 0.25 * float(self._rng.random())
+                self._sleep_backpressure(delay)
+                continue
+            return resp
+
+    def _note_reply(self, resp: dict[str, Any]) -> None:
+        credits = resp.get("credits")
+        if credits is not None:
+            self.bucket.grant(int(credits))
+        version = resp.get("params_version")
+        if version is not None:
+            self.params_version = max(self.params_version, int(version))
+
+    def _sleep_backpressure(self, seconds: float) -> None:
+        """Sleep in short slices so shutdown stays responsive and the
+        liveness hook keeps firing — a throttled actor must read as
+        intentionally waiting, never as hung."""
+        end = time.monotonic() + seconds
+        while True:
+            if self._should_abort is not None and self._should_abort():
+                raise ConnectionAbortedError(
+                    "aborted while waiting out backpressure")
+            if self.on_backpressure is not None:
+                self.on_backpressure()
+            remaining = end - time.monotonic()
+            if remaining <= 0.0:
+                return
+            time.sleep(min(remaining, 0.2))
 
     def get_params(self, have_version: int = -1):
         """Returns (version, weights-or-None) like the raw stub."""

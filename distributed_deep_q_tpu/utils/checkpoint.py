@@ -20,6 +20,19 @@ import jax
 import numpy as np
 
 
+def _jsonable(v: Any):
+    """JSON-safe coercion that PRESERVES int/float distinction: counters
+    like ``env_steps`` must round-trip as ints (a blanket ``float(v)``
+    silently turned them into floats, and consumers doing exact-step
+    arithmetic inherited float error past 2**53). Bools pass through as
+    bools; numpy scalars land as their Python kind."""
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return float(v)
+
+
 def _manager(directory: str, keep: int = 3):
     import orbax.checkpoint as ocp
     return ocp.CheckpointManager(
@@ -45,7 +58,7 @@ class Checkpointer:
         self._mgr.save(step, args=ocp.args.Composite(
             state=ocp.args.StandardSave(state),
             extra=ocp.args.JsonSave(
-                {k: float(v) for k, v in (extra or {}).items()}),
+                {k: _jsonable(v) for k, v in (extra or {}).items()}),
         ))
         if wait:
             self._mgr.wait_until_finished()

@@ -1,6 +1,6 @@
 """Chaos smoke — prove the RPC fault-tolerance stack end to end.
 
-Two modes:
+Three modes:
 
 ``python scripts/chaos_smoke.py [num_actors] [spec]`` (default)
     Threaded actor fleet over the production wire protocol: resilient
@@ -10,6 +10,14 @@ Two modes:
     same port. Prints one JSON verdict line; exit status 1 if any
     transition was lost or duplicated. Fast (seconds), CPU-only, no jax —
     runnable on any box as a release gate for the resilience plane.
+
+``python scripts/chaos_smoke.py overload [spec]``
+    Overload acceptance (ISSUE 5): a producer fleet deliberately outruns a
+    rate-capped consumer, so the server's admission controller must shed —
+    the gate is *shed but never lost*: every transition lands exactly once
+    (the shed flush re-stages under its original ``flush_seq``), sheds
+    actually fired, and the clients' token buckets paced to the granted
+    credits. Chaos delays compose on top via the optional spec.
 
 ``python scripts/chaos_smoke.py train [cfg.overrides ...]``
     The full distributed trainer (spawned actor processes, mesh learner)
@@ -123,6 +131,116 @@ def run_chaos_smoke(num_actors: int = 4, flushes: int = 120, rows: int = 8,
     return verdict
 
 
+def run_overload_smoke(num_actors: int = 3, flushes: int = 40, rows: int = 16,
+                       spec: str = "delay=0.05:20,seed=13",
+                       consume_rate: float = 300.0,
+                       deadline: float = 120.0) -> dict:
+    """Producer fleet ~10× faster than a rate-capped consumer: the server
+    MUST shed, and the gate is shed-but-never-lost — exactly-once delivery
+    of every labeled transition despite admission control plus chaos."""
+    from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
+    from distributed_deep_q_tpu.rpc import faultinject
+    from distributed_deep_q_tpu.rpc.flowcontrol import FlowConfig
+    from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedServer
+    from distributed_deep_q_tpu.rpc.resilience import (
+        ResilientReplayFeedClient, RetryPolicy)
+
+    plan = faultinject.install(spec) if spec else None
+    total = num_actors * flushes * rows
+    replay = ReplayMemory(max(2 * total, 1024), (2,), np.float32, seed=0)
+    # tight ingest_factor so the mismatch branch trips as soon as the
+    # consumer's rate is observable; floor small enough to actually pace
+    flow = FlowConfig(ingest_factor=1.5, flush_credit_floor=8,
+                      rate_halflife_s=0.5)
+    server = ReplayFeedServer(replay, flow=flow)
+    host, port = server.address
+    policy = RetryPolicy(base_delay=0.01, max_delay=0.2, deadline=deadline)
+    errors: list[str] = []
+    stop = threading.Event()
+    clients: list = [None] * num_actors
+
+    def consumer() -> None:
+        # rate-capped learner stand-in: sample under the server's lock,
+        # feed the flow controller's consumption EWMA
+        batch = 32
+        while not stop.is_set():
+            with server.replay_lock:
+                ready = len(replay) >= batch
+                if ready:
+                    replay.sample(batch)
+            if ready:
+                server.note_consumed(batch)
+                time.sleep(batch / consume_rate)
+            else:
+                time.sleep(0.005)
+
+    def actor(aid: int) -> None:
+        try:
+            c = ResilientReplayFeedClient.connect(
+                host, port, actor_id=aid, policy=policy, seed=200 + aid)
+            clients[aid] = c
+            for f in range(flushes):  # no pacing: outrun the consumer
+                ids = aid * 1_000_000 + f * 1_000 + np.arange(
+                    rows, dtype=np.float32)
+                obs = np.stack([ids, ids], axis=1)
+                c.add_transitions(
+                    obs=obs, action=np.zeros(rows, np.int32),
+                    reward=np.zeros(rows, np.float32), next_obs=obs,
+                    discount=np.ones(rows, np.float32))
+            c.close()
+        except Exception as e:  # noqa: BLE001 — reported in the verdict
+            errors.append(f"actor {aid}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=actor, args=(a,), daemon=True)
+               for a in range(num_actors)]
+    drain = threading.Thread(target=consumer, daemon=True)
+    t0 = time.perf_counter()
+    drain.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=deadline)
+    hung = sum(t.is_alive() for t in threads)
+    stop.set()
+    drain.join(timeout=5)
+    wall = time.perf_counter() - t0
+
+    rpc = server.telemetry.robustness_counters()
+    fc = server.flow_counters()
+    expected = {a * 1_000_000 + f * 1_000 + r for a in range(num_actors)
+                for f in range(flushes) for r in range(rows)}
+    observed = replay.obs[:len(replay), 0].astype(np.int64).tolist()
+    lost = len(expected) - len(set(observed))
+    duplicated = len(observed) - len(set(observed))
+    client_sheds = sum(c.sheds for c in clients if c is not None)
+    throttled = sum(c.throttled_s for c in clients if c is not None)
+    verdict = {
+        # the acceptance: overload produced sheds AND nothing was lost or
+        # duplicated — backpressure is explicit cooperation, not data loss
+        "ok": (not errors and not hung and lost == 0 and duplicated == 0
+               and rpc["shed_flushes"] > 0),
+        "num_actors": num_actors,
+        "transitions_sent": total,
+        "transitions_stored": len(observed),
+        "lost": lost,
+        "duplicated": duplicated,
+        "shed_flushes": rpc["shed_flushes"],
+        "client_sheds": client_sheds,
+        "client_throttled_s": round(throttled, 3),
+        "duplicate_flushes_absorbed": rpc["duplicate_flushes"],
+        "degraded_trips": fc["degraded_trips"],
+        "consume_rate_cap": consume_rate,
+        "chaos_spec": spec,
+        "faults_fired": dict(sorted(plan.counters.items())) if plan else {},
+        "hung_actors": hung,
+        "errors": errors,
+        "wall_s": round(wall, 2),
+    }
+    server.close()
+    faultinject.uninstall()
+    return verdict
+
+
 def run_train_chaos(argv: list[str]) -> dict:
     import jax
 
@@ -178,6 +296,11 @@ if __name__ == "__main__":
     if args and args[0] == "train":
         print(json.dumps(run_train_chaos(args[1:]), default=str))
         sys.exit(0)
+    if args and args[0] in ("overload", "--overload"):
+        verdict = run_overload_smoke(
+            spec=args[1] if len(args) > 1 else "delay=0.05:20,seed=13")
+        print(json.dumps(verdict))
+        sys.exit(0 if verdict["ok"] else 1)
     n, spec = 4, "drop=0.03,truncate=0.02,seed=11"
     for arg in args:
         if arg.isdigit():

@@ -80,6 +80,33 @@ def test_checkpoint_resume_continues_identically(tmp_path):
     assert int(b2.state.step) == 10
 
 
+def test_extra_preserves_scalar_kinds(tmp_path):
+    """Regression: ``extra`` values must round-trip with their Python kind
+    intact — a blanket float() coercion silently turned step counters into
+    floats (exact-step arithmetic drifts past 2**53)."""
+    s = _solver()
+    rng = np.random.default_rng(0)
+    s.train_step(_batch(rng))
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    ckpt.save(s.state, extra={
+        "env_steps": 123,
+        "big": 2**53 + 1,            # not representable as float64
+        "lr": 6.25e-5,
+        "np_int": np.int64(77),
+        "np_float": np.float32(0.5),
+        "flag": True,
+    }, wait=True)
+    _, extra = ckpt.restore(s.state)
+    assert extra["env_steps"] == 123 and type(extra["env_steps"]) is int
+    assert extra["big"] == 2**53 + 1 and type(extra["big"]) is int
+    assert extra["lr"] == pytest.approx(6.25e-5)
+    assert type(extra["lr"]) is float
+    assert extra["np_int"] == 77 and type(extra["np_int"]) is int
+    assert extra["np_float"] == pytest.approx(0.5)
+    assert type(extra["np_float"]) is float
+    assert extra["flag"] is True
+
+
 def test_keep_retention(tmp_path):
     s = _solver()
     rng = np.random.default_rng(0)

@@ -202,6 +202,27 @@ def test_chaos_socket_corrupt_flips_exactly_one_bit():
         b.close()
 
 
+def test_chaos_spec_parses_throttle():
+    p = ChaosPlan.from_spec("throttle=4096,seed=3")
+    assert p.throttle == 4096.0 and p.seed == 3
+
+
+def test_chaos_socket_throttle_is_deterministic_and_counts():
+    a, b = socket.socketpair()
+    try:
+        plan = ChaosPlan(throttle=10_000.0, seed=1)  # 10 kB/s
+        cs = ChaosSocket(a, plan, side="client")
+        t0 = time.perf_counter()
+        cs.sendall(b"x" * 1000)  # 1000 B / 10 kB/s = 100 ms wire time
+        elapsed = time.perf_counter() - t0
+        assert elapsed >= 0.1
+        assert b.recv(2000) == b"x" * 1000  # data itself is untouched
+        assert plan.counters["client/throttle"] == 1
+    finally:
+        a.close()
+        b.close()
+
+
 def test_chaos_socket_truncate_sends_prefix_then_drops():
     a, b = socket.socketpair()
     try:
@@ -373,6 +394,25 @@ def test_server_drops_garbage_connection_and_keeps_serving(feed_server):
         assert c.call("heartbeat")["ok"]  # fresh clients unaffected
     finally:
         c.close()
+
+
+def test_call_timeout_is_retryable():
+    """A server that accepts but never answers must surface as a timeout
+    on the configured deadline, and the retry policy must classify it as
+    retryable (socket.timeout is an OSError) — the actor rides it out
+    instead of dying."""
+    lst = socket.create_server(("127.0.0.1", 0))  # listens, never replies
+    host, port = lst.getsockname()
+    c = ReplayFeedClient(host, port, actor_id=1, timeout=0.2)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RetryPolicy().retryable) as exc:
+            c.call("heartbeat")
+        assert isinstance(exc.value, (TimeoutError, socket.timeout))
+        assert time.monotonic() - t0 < 3.0  # bounded by the 0.2s timeout
+    finally:
+        c.close()
+        lst.close()
 
 
 def test_resilient_client_rejected_flush_raises_rpc_error(feed_server):
