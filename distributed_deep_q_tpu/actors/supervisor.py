@@ -676,7 +676,8 @@ def _bring_up_rpc_plane(cfg: Config, replay):
         rss_high_watermark_mb=cfg.replay.rss_high_watermark_mb)
     server = ReplayFeedServer(replay, host=cfg.actors.host,
                               port=cfg.actors.port if snap else 0,
-                              snapshot_path=snap, flow=flow)
+                              snapshot_path=snap, flow=flow,
+                              snapshot_keep=cfg.train.snapshot_keep)
     host, port = server.address
     sup = ActorSupervisor(cfg, host, port)
     sup.start()
@@ -879,7 +880,10 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                 ckpt.save(solver.state,
                           extra={"env_steps": server.counters()["env_steps"]})
                 if cfg.train.server_snapshot_path:
-                    server.snapshot(cfg.train.server_snapshot_path)
+                    # capture-only under the lock; serialize + fsync in a
+                    # background thread (a still-running previous dump
+                    # just skips this tick — counted, never stacked)
+                    server.snapshot_async(cfg.train.server_snapshot_path)
 
             if gstep % log_every == 0:
                 timer.measure_device(m["loss"])
@@ -918,6 +922,8 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     summary["rpc_dispatch_errors"] = rpc["dispatch_errors"]
     summary["rpc_duplicate_flushes"] = rpc["duplicate_flushes"]
     summary["rpc_shed_flushes"] = rpc["shed_flushes"]
+    summary["rpc_checksum_errors"] = rpc["checksum_errors"]
+    summary["snapshot_quarantined"] = rpc["snapshot_quarantined"]
     summary["flow_degraded_trips"] = server.flow_counters()["degraded_trips"]
     summary["solver"] = solver
     summary["replay"] = replay
@@ -1065,7 +1071,8 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
                 ckpt.save(solver.state,
                           extra={"env_steps": server.counters()["env_steps"]})
                 if cfg.train.server_snapshot_path:
-                    server.snapshot(cfg.train.server_snapshot_path)
+                    # non-blocking: capture under the lock, write off-lock
+                    server.snapshot_async(cfg.train.server_snapshot_path)
             if gstep % log_every == 0:
                 counts = server.counters()
                 summary = {
@@ -1096,6 +1103,8 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
     summary["rpc_dispatch_errors"] = rpc["dispatch_errors"]
     summary["rpc_duplicate_flushes"] = rpc["duplicate_flushes"]
     summary["rpc_shed_flushes"] = rpc["shed_flushes"]
+    summary["rpc_checksum_errors"] = rpc["checksum_errors"]
+    summary["snapshot_quarantined"] = rpc["snapshot_quarantined"]
     summary["flow_degraded_trips"] = server.flow_counters()["degraded_trips"]
     summary["solver"] = solver
     summary["replay"] = replay

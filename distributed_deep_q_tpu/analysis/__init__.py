@@ -1,13 +1,14 @@
 """Repo-native static analysis — machine-checked concurrency/JAX/RPC
 invariants.
 
-Four passes, one entry point:
+Five passes, one entry point:
 
 - ``locks``          — guarded-attribute lock discipline + static
                        lock-order deadlock detection
 - ``purity``         — side effects inside jit/pmap/shard_map traces
 - ``protocol_drift`` — RPC client/server/wire skew
 - ``config_keys``    — ``cfg.<section>.<field>`` existence
+- ``atomic_writes``  — raw binary writes bypassing the durability plane
 
 ``run_all(repo_root)`` returns every finding; ``scripts/analysis_gate.py``
 is the CLI gate (exit non-zero on findings) and a tier-1 test keeps the
@@ -21,7 +22,7 @@ import os
 
 from distributed_deep_q_tpu.analysis.core import Finding, Source
 from distributed_deep_q_tpu.analysis import (  # noqa: F401
-    config_keys, locks, protocol_drift, purity)
+    atomic_writes, config_keys, locks, protocol_drift, purity)
 
 __all__ = ["Finding", "Source", "run_all", "repo_root"]
 
@@ -39,4 +40,5 @@ def run_all(root: str | None = None) -> list[Finding]:
     findings += purity.check(root)
     findings += protocol_drift.check(root)
     findings += config_keys.check(root)
+    findings += atomic_writes.check(root)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

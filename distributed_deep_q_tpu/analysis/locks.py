@@ -52,8 +52,10 @@ class LockRegistry:
     attrs: dict[str, Guard] = field(default_factory=dict)
     # file-suffix → {global name → module-level lock name}
     globals: dict[str, dict[str, str]] = field(default_factory=dict)
-    # methods that run single-threaded (construction / warm boot)
-    unlocked_methods: frozenset = frozenset({"__init__", "_restore"})
+    # methods that run single-threaded (construction / warm boot — the
+    # generational restore helpers run before the listener opens)
+    unlocked_methods: frozenset = frozenset(
+        {"__init__", "_restore", "_load_generation", "_reset_boot_state"})
     # repo-relative files this pass walks
     files: tuple[str, ...] = ()
 
@@ -111,6 +113,24 @@ DEFAULT_REGISTRY = LockRegistry(
                                   ("self", "server.telemetry")),
         "conn_timeouts":    Guard("_lock", "ServerTelemetry",
                                   ("self", "server.telemetry")),
+        # durability plane gauges (ISSUE 6): CRC rejections + snapshot
+        # cadence/stall/quarantine counters
+        "checksum_errors":  Guard("_lock", "ServerTelemetry",
+                                  ("self", "server.telemetry")),
+        "snapshot_count":   Guard("_lock", "ServerTelemetry",
+                                  ("self", "server.telemetry")),
+        "snapshot_skipped": Guard("_lock", "ServerTelemetry",
+                                  ("self", "server.telemetry")),
+        "snapshot_capture_ms": Guard("_lock", "ServerTelemetry",
+                                     ("self", "server.telemetry")),
+        "snapshot_write_ms": Guard("_lock", "ServerTelemetry",
+                                   ("self", "server.telemetry")),
+        "snapshot_bytes":   Guard("_lock", "ServerTelemetry",
+                                  ("self", "server.telemetry")),
+        "snapshot_generations": Guard("_lock", "ServerTelemetry",
+                                      ("self", "server.telemetry")),
+        "snapshot_quarantined": Guard("_lock", "ServerTelemetry",
+                                      ("self", "server.telemetry")),
         # FlowController overload state shares the server's replay_lock so
         # admission is atomic with the insert it gates
         "credits":          Guard("replay_lock", "FlowController"),

@@ -152,6 +152,10 @@ class TrainConfig:
     # cadence and on exit), and warm-boots from it — a restarted learner
     # resumes with its replay intact while actors simply reconnect
     server_snapshot_path: str = ""
+    # generational snapshot retention: server_snapshot_path holds the
+    # newest N checksummed generations; restore walks newest→oldest past
+    # any torn/corrupt one (quarantined, not fatal)
+    snapshot_keep: int = 3
     # profiling (SURVEY §5.1): jax.profiler trace of a step window, and an
     # optional live profiler server port (0 = off)
     profile_dir: str = ""

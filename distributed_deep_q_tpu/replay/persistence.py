@@ -22,8 +22,11 @@ mismatched buffer.
 from __future__ import annotations
 
 import json
+import os
 
 import numpy as np
+
+from distributed_deep_q_tpu.utils.durability import atomic_write, savez_bytes
 
 SCHEMA = 1
 
@@ -77,8 +80,32 @@ def _frame_stack_restore(m, z, prefix: str) -> None:
 _SEQ_META = ("action", "reward", "discount", "mask", "init_c", "init_h")
 
 
+def _owned(d: dict) -> dict:
+    """Snapshot isolation for a captured state dict: copy host-resident
+    array views so the caller can serialize off-lock while the replay
+    keeps mutating. ``dev_*`` keys are fresh HBM downloads (np.asarray
+    of device arrays) and already owned."""
+    return {k: np.array(v) if isinstance(v, np.ndarray)
+            and not k.startswith("dev_") else v
+            for k, v in d.items()}
+
+
 def save_replay(replay, path: str) -> None:
-    """Dump ``replay``'s complete sampling state to ``path`` (npz)."""
+    """Dump ``replay``'s complete sampling state to ``path`` atomically
+    (tmp + fsync + rename — ``np.savez`` straight to the final path
+    leaves a torn file on crash). Mirrors np.savez's historical naming:
+    ``.npz`` is appended when ``path`` lacks it."""
+    path = os.fspath(path)
+    if not path.endswith(".npz"):
+        path += ".npz"
+    atomic_write(path, savez_bytes(**replay_state(replay)))
+
+
+def replay_state(replay) -> dict:
+    """Capture ``replay``'s complete sampling state as a flat dict (the
+    npz key space of ``save_replay``). Every array is owned by the
+    result — callers holding ``replay_lock`` can capture briefly and
+    serialize/fsync after releasing it."""
     from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
     from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
     from distributed_deep_q_tpu.replay.device_sequence import (
@@ -104,8 +131,7 @@ def save_replay(replay, path: str) -> None:
         d["rng"] = _rng_dump(replay._rng)
         if replay.prioritized:
             d["tree"] = replay.tree.tree
-        np.savez(path, **d)
-        return
+        return _owned(d)
 
     if isinstance(replay, DeviceSequenceReplay):
         replay.flush()  # staged sequences must be in the state we dump
@@ -130,8 +156,7 @@ def save_replay(replay, path: str) -> None:
         for k, v in replay.dmeta.items():
             d[f"dev_{k}"] = np.asarray(v)
         d["dev_maxp"] = np.asarray(replay.dmaxp)
-        np.savez(path, **d)
-        return
+        return _owned(d)
 
     if isinstance(replay, PrioritizedReplay):
         d["meta_kind"] = "prioritized"
@@ -183,7 +208,7 @@ def save_replay(replay, path: str) -> None:
         })
     else:
         raise TypeError(f"no persistence for {type(replay).__name__}")
-    np.savez(path, **d)
+    return _owned(d)
 
 
 def load_replay(replay, path: str) -> None:

@@ -2,6 +2,7 @@
 fault-tolerance layer (retry/backoff, idempotent flushes, chaos injection).
 """
 
-from distributed_deep_q_tpu.rpc.protocol import ProtocolError  # noqa: F401
+from distributed_deep_q_tpu.rpc.protocol import (  # noqa: F401
+    ChecksumError, ProtocolError)
 from distributed_deep_q_tpu.rpc.resilience import (  # noqa: F401
     ResilientReplayFeedClient, RetryPolicy, RPCError)

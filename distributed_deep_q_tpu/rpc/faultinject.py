@@ -16,6 +16,13 @@ runs can prove the retry/dedup/warm-boot machinery absorbs them:
 - ``throttle``  — bandwidth cap in bytes/second on sends (slow link), so
                   overload can be induced at the transport layer instead
                   of by fleet sizing (ISSUE 5)
+- ``torn``      — damage a snapshot file mid-write (truncate to a random
+                  prefix or garbage-fill a random span) before the atomic
+                  rename, modeling the disk-level tear that tmp+fsync+
+                  rename cannot prevent; the generation store's manifest
+                  checksums must quarantine it on restore (ISSUE 6).
+                  Fires inside ``utils.durability.atomic_write``, not on
+                  sockets.
 
 Install programmatically (``install("drop=0.05,seed=1")``) or via the
 ``DDQ_CHAOS`` environment variable, which spawned actor processes inherit —
@@ -57,6 +64,7 @@ class ChaosPlan:
     stall_p: float = 0.0     # P(sleep before recv)
     stall_ms: float = 50.0   # max stall, uniform [0, stall_ms]
     throttle: float = 0.0    # bytes/second bandwidth cap on sends (0 = off)
+    torn: float = 0.0        # P(tear a snapshot file write) per atomic_write
     seed: int = 0
     counters: dict = field(default_factory=dict)
 
@@ -84,7 +92,7 @@ class ChaosPlan:
                     kv[f"{name}_ms"] = float(ms)
             elif name == "seed":
                 kv["seed"] = int(val)
-            elif name in ("drop", "truncate", "corrupt", "throttle"):
+            elif name in ("drop", "truncate", "corrupt", "throttle", "torn"):
                 kv[name] = float(val)
             else:
                 raise ValueError(f"unknown chaos knob {name!r} in {spec!r}")

@@ -9,21 +9,27 @@ receive), no HTTP framing overhead, zero-copy numpy buffer sends.
 A message is a dict[str, ndarray | int | float | bool | str | None]:
 
     u8  magic 0xD9   (frame-boundary guard: a desynced or corrupted stream
-    u8  version 3     is detected HERE, not as a reshape error in dispatch)
-    u32 LE  total payload length
+    u8  version 4     is detected HERE, not as a reshape error in dispatch)
+    u32 LE  total payload length   (excludes the trailer)
     u16 LE  item count
     per item:
       u16 LE keylen, key utf-8
       u8 kind  (0 ndarray, 1 int64, 2 float64, 3 str, 4 bool, 5 none)
       ndarray: u8 dtypelen, dtype str, u8 ndim, u32×ndim shape, u64 nbytes, raw
       int64/float64: 8 bytes; str: u32 len + utf-8; bool: u8
+    u32 LE  CRC-32C of the payload  (v4+ trailer)
 
 Every length/offset in ``decode`` is bounds-checked and the ndarray item
 enforces ``nbytes == prod(shape) * itemsize``, so truncated or bit-flipped
 frames raise ``ProtocolError`` instead of over-reading or mis-parsing into a
-valid-looking message. ``ProtocolError`` subclasses ``ValueError`` so
-existing transient-failure handlers (heartbeat backoff, client socket drop)
-classify it as a retryable stream fault.
+valid-looking message. Structural checks alone cannot catch a bit flip
+inside array *data*, which would silently poison replay — the v4 CRC-32C
+trailer closes that hole: ``recv_msg_sized`` verifies it before decode and
+raises ``ChecksumError`` (counted as ``rpc/checksum_errors`` server-side).
+``ProtocolError`` subclasses ``ValueError`` so existing transient-failure
+handlers (heartbeat backoff, client socket drop) classify it as a retryable
+stream fault; ``ChecksumError`` subclasses ``ProtocolError`` so the retry
+plane re-sends a corrupted frame instead of admitting it.
 """
 
 from __future__ import annotations
@@ -34,16 +40,25 @@ from typing import Any
 
 import numpy as np
 
+from distributed_deep_q_tpu.utils.durability import crc32c
+
 MAX_MESSAGE = 1 << 30  # 1 GiB sanity cap
 
 MAGIC = 0xD9
 # v3 (ISSUE 5): add_transitions replies grew credit/SHED/params_version
 # fields. Payload encoding is byte-identical to v2 (the new surface is
 # plain dict entries), so v2 frames remain decodable — see ``reframe``.
-WIRE_VERSION = 3
-_COMPAT_PAYLOAD_VERSIONS = (2, 3)
+# v4 (ISSUE 6): CRC-32C trailer appended after the payload. The payload
+# encoding itself is still byte-identical, so v2/v3 stored frames are
+# re-stamped by ``reframe`` (which computes the missing trailer), and
+# ``recv_msg_sized`` still accepts trailer-less v3 peers.
+WIRE_VERSION = 4
+_COMPAT_PAYLOAD_VERSIONS = (2, 3, 4)
+_TRAILERLESS_VERSIONS = (3,)  # live peers accepted without a trailer
 _HEADER = struct.Struct("<BBI")  # magic, version, payload length
 HEADER_SIZE = _HEADER.size
+_TRAILER = struct.Struct("<I")  # CRC-32C of the payload (v4+)
+TRAILER_SIZE = _TRAILER.size
 
 _KIND_NDARRAY, _KIND_INT, _KIND_FLOAT, _KIND_STR, _KIND_BOOL, _KIND_NONE = range(6)
 
@@ -55,6 +70,12 @@ _MAX_ITEMS = 4096
 
 class ProtocolError(ValueError):
     """Malformed / truncated / desynced wire frame."""
+
+
+class ChecksumError(ProtocolError):
+    """Frame payload failed CRC-32C verification — corrupt in transit or
+    at rest. Subclasses ``ProtocolError`` (hence retryable), but counted
+    separately so silent-corruption pressure is visible in telemetry."""
 
 
 def encode(msg: dict[str, Any]) -> bytes:
@@ -89,7 +110,9 @@ def encode(msg: dict[str, Any]) -> bytes:
         else:
             raise TypeError(f"unsupported message value {key}={type(val)}")
     payload = b"".join(parts)
-    return _HEADER.pack(MAGIC, WIRE_VERSION, len(payload)) + payload
+    # header length counts the payload only; the CRC trailer rides after
+    return (_HEADER.pack(MAGIC, WIRE_VERSION, len(payload)) + payload
+            + _TRAILER.pack(crc32c(payload)))
 
 
 def decode(payload: bytes) -> dict[str, Any]:
@@ -197,25 +220,37 @@ def reframe(frame: bytes) -> bytes:
     Warm-boot snapshots persist the published θ frame verbatim
     (``params_wire``); after a version bump that frame would fail the
     receiver's version check even though the run is otherwise resumable.
-    Payload-compatible versions are re-stamped in place; anything else is
-    a real format change and must fail loudly rather than mis-parse."""
+    Payload-compatible versions are re-stamped in place — v2/v3 frames
+    (no trailer) get the CRC-32C trailer computed and appended; a v4
+    frame has its trailer *verified* (the snapshot sat on disk) and is
+    returned as-is. Anything else is a real format change and must fail
+    loudly rather than mis-parse."""
     if len(frame) < HEADER_SIZE:
         raise ProtocolError(f"stored frame of {len(frame)} bytes is shorter "
                             "than a header")
     magic, version, length = _HEADER.unpack_from(frame)
     if magic != MAGIC:
         raise ProtocolError(f"stored frame has bad magic 0x{magic:02x}")
-    if length != len(frame) - HEADER_SIZE:
-        raise ProtocolError(
-            f"stored frame length {length} disagrees with "
-            f"{len(frame) - HEADER_SIZE} payload bytes")
-    if version == WIRE_VERSION:
-        return frame
     if version not in _COMPAT_PAYLOAD_VERSIONS:
         raise ProtocolError(
             f"stored frame speaks wire version {version}; payload format "
             f"is not compatible with {WIRE_VERSION}")
-    return _HEADER.pack(MAGIC, WIRE_VERSION, length) + frame[HEADER_SIZE:]
+    trailer = TRAILER_SIZE if version >= 4 else 0
+    if length != len(frame) - HEADER_SIZE - trailer:
+        raise ProtocolError(
+            f"stored v{version} frame length {length} disagrees with "
+            f"{len(frame) - HEADER_SIZE - trailer} payload bytes")
+    payload = frame[HEADER_SIZE:HEADER_SIZE + length]
+    if trailer:
+        (want,) = _TRAILER.unpack_from(frame, HEADER_SIZE + length)
+        got = crc32c(payload)
+        if got != want:
+            raise ChecksumError(
+                f"stored frame crc32c {got:08x} != trailer {want:08x} — "
+                "snapshot corrupt at rest")
+        return frame
+    return (_HEADER.pack(MAGIC, WIRE_VERSION, length) + payload
+            + _TRAILER.pack(crc32c(payload)))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -246,9 +281,17 @@ def recv_msg_sized(sock: socket.socket) -> tuple[dict[str, Any], int]:
         raise ProtocolError(
             f"bad magic 0x{magic:02x} (expected 0x{MAGIC:02x}) — stream "
             "desynced or peer speaks a different protocol")
-    if version != WIRE_VERSION:
+    if version != WIRE_VERSION and version not in _TRAILERLESS_VERSIONS:
         raise ProtocolError(
             f"wire version {version} (this side speaks {WIRE_VERSION})")
     if length > MAX_MESSAGE:
         raise ProtocolError(f"message of {length} bytes exceeds cap")
-    return decode(_recv_exact(sock, length)), length
+    payload = _recv_exact(sock, length)
+    if version >= 4:
+        (want,) = _TRAILER.unpack(_recv_exact(sock, TRAILER_SIZE))
+        got = crc32c(payload)
+        if got != want:
+            raise ChecksumError(
+                f"payload crc32c {got:08x} != trailer {want:08x} — frame "
+                "corrupted in transit")
+    return decode(payload), length

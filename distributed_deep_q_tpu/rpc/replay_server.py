@@ -36,7 +36,10 @@ from distributed_deep_q_tpu.metrics import Histogram
 from distributed_deep_q_tpu.rpc import faultinject
 from distributed_deep_q_tpu.rpc.flowcontrol import FlowConfig, FlowController
 from distributed_deep_q_tpu.rpc.protocol import (
-    ProtocolError, encode, recv_msg, recv_msg_sized, reframe, send_msg)
+    ChecksumError, ProtocolError, encode, recv_msg, recv_msg_sized, reframe,
+    send_msg)
+from distributed_deep_q_tpu.utils.durability import (
+    GenerationStore, savez_bytes)
 
 log = logging.getLogger(__name__)
 
@@ -80,10 +83,44 @@ class ServerTelemetry:
         self.shed_flushes = 0
         self.actor_sheds: dict[int, int] = {}
         self.conn_timeouts = 0
+        # durability plane: frames rejected by the wire-v4 CRC trailer
+        # (each one is a prevented silent replay poisoning — the client
+        # re-sends through its retry policy), snapshot cadence/size/stall
+        # gauges, and generations quarantined by integrity checks
+        self.checksum_errors = 0
+        self.snapshot_count = 0
+        self.snapshot_skipped = 0
+        self.snapshot_capture_ms = 0.0  # lock-hold time (the stall)
+        self.snapshot_write_ms = 0.0    # off-lock serialize + fsync
+        self.snapshot_bytes = 0
+        self.snapshot_generations = 0
+        self.snapshot_quarantined = 0
 
     def record_dispatch_error(self) -> None:
         with self._lock:
             self.dispatch_errors += 1
+
+    def record_checksum_error(self) -> None:
+        with self._lock:
+            self.checksum_errors += 1
+
+    def record_snapshot(self, capture_ms: float, write_ms: float,
+                        nbytes: int, generations: int) -> None:
+        with self._lock:
+            self.snapshot_count += 1
+            self.snapshot_capture_ms = capture_ms
+            self.snapshot_write_ms = write_ms
+            self.snapshot_bytes = nbytes
+            self.snapshot_generations = generations
+
+    def record_snapshot_skip(self) -> None:
+        with self._lock:
+            self.snapshot_skipped += 1
+
+    def record_quarantined(self, n: int) -> None:
+        if n:
+            with self._lock:
+                self.snapshot_quarantined += n
 
     def record_duplicate_flush(self) -> None:
         with self._lock:
@@ -160,6 +197,14 @@ class ServerTelemetry:
             out["rpc/duplicate_flushes"] = self.duplicate_flushes
             out["rpc/shed_flushes"] = self.shed_flushes
             out["rpc/conn_timeouts"] = self.conn_timeouts
+            out["rpc/checksum_errors"] = self.checksum_errors
+            out["durability/snapshot_count"] = self.snapshot_count
+            out["durability/snapshot_skipped"] = self.snapshot_skipped
+            out["durability/snapshot_capture_ms"] = self.snapshot_capture_ms
+            out["durability/snapshot_write_ms"] = self.snapshot_write_ms
+            out["durability/snapshot_bytes"] = self.snapshot_bytes
+            out["durability/generations"] = self.snapshot_generations
+            out["durability/quarantined"] = self.snapshot_quarantined
             return out
 
     def per_actor_env_steps(self) -> tuple[np.ndarray, np.ndarray]:
@@ -183,7 +228,10 @@ class ServerTelemetry:
             return {"dispatch_errors": self.dispatch_errors,
                     "duplicate_flushes": self.duplicate_flushes,
                     "shed_flushes": self.shed_flushes,
-                    "conn_timeouts": self.conn_timeouts}
+                    "conn_timeouts": self.conn_timeouts,
+                    "checksum_errors": self.checksum_errors,
+                    "snapshot_quarantined": self.snapshot_quarantined,
+                    "snapshot_skipped": self.snapshot_skipped}
 
 
 class ReplayFeedServer:
@@ -194,9 +242,17 @@ class ReplayFeedServer:
     ERR_LOG_PERIOD = 5.0
 
     def __init__(self, replay, host: str = "127.0.0.1", port: int = 0,
-                 snapshot_path: str = "", flow: FlowConfig | None = None):
+                 snapshot_path: str = "", flow: FlowConfig | None = None,
+                 snapshot_keep: int = 3):
         self.replay = replay
         self.telemetry = ServerTelemetry()
+        self.snapshot_keep = snapshot_keep
+        # serializes snapshot attempts; held across the async write so an
+        # overlapping cadence tick skips instead of racing the generation
+        # counter. Acquired in the caller, released by the writer thread —
+        # legal for a plain Lock, and why this is NOT an RLock.
+        self._snap_lock = threading.Lock()
+        self._restored_generation = -1  # set by a generational warm boot
         # RLock: stats/mean_recent_return may be read under an already-held
         # guard (e.g. inside the add_transitions/stats handlers)
         self.replay_lock = threading.RLock()
@@ -330,15 +386,15 @@ class ReplayFeedServer:
     # their retry policy — no restarts, no lost replay, no duplicate
     # flushes (the dedup map rides in the snapshot).
 
-    def _snapshot_files(self, path: str) -> tuple[str, str]:
-        return f"{path}.server.npz", f"{path}.replay.npz"
+    def _capture_state(self) -> tuple[dict[str, Any], dict | None,
+                                      int, float]:
+        """Capture everything a snapshot persists, under ``replay_lock``
+        only as long as the copy takes. Returns ``(server state, replay
+        state | None, params_version, capture_ms)`` — all owned data the
+        caller may serialize and fsync with no lock held."""
+        from distributed_deep_q_tpu.replay.persistence import replay_state
 
-    def snapshot(self, path: str) -> None:
-        """Dump server state (+ replay when its tier supports persistence)
-        without stopping service — safe at checkpoint cadence."""
-        from distributed_deep_q_tpu.replay.persistence import save_replay
-
-        server_file, replay_file = self._snapshot_files(path)
+        t0 = time.perf_counter()
         with self.replay_lock:
             with self._params_lock:
                 wire = self._params_wire
@@ -356,31 +412,95 @@ class ReplayFeedServer:
                 "params_wire": np.frombuffer(wire, np.uint8)
                 if wire is not None else np.zeros(0, np.uint8),
             }
-            np.savez(server_file, **state)
+            rstate = None
             if self.replay is not None:
                 try:
-                    save_replay(self.replay, replay_file)
+                    rstate = replay_state(self.replay)
                 except TypeError as e:  # tier without persistence support
                     log.warning("server snapshot: replay not persisted "
                                 "(%s); counters/params saved", e)
+        return state, rstate, version, 1e3 * (time.perf_counter() - t0)
+
+    def _write_snapshot(self, path: str, cap: tuple) -> int:
+        """Serialize + commit one captured generation. Runs with NO lock
+        but ``_snap_lock`` held by the caller (sync) or inherited from it
+        (async)."""
+        state, rstate, version, capture_ms = cap
+        t0 = time.perf_counter()
+        files = {"server.npz": savez_bytes(**state)}
+        if rstate is not None:
+            files["replay.npz"] = savez_bytes(**rstate)
+        store = GenerationStore(path, keep=self.snapshot_keep)
+        gen = store.commit(files, meta={"params_version": version,
+                                        "env_steps": int(state["env_steps"])})
+        nbytes = sum(len(b) for b in files.values())
+        self.telemetry.record_snapshot(
+            capture_ms, 1e3 * (time.perf_counter() - t0), nbytes,
+            len(store.generations()))
+        return gen
+
+    def snapshot(self, path: str) -> int:
+        """Dump server state (+ replay when its tier supports persistence)
+        as one checksummed snapshot generation, without stopping service.
+        ``replay_lock`` is held only for the in-memory capture; serialize
+        + fsync happen off-lock, so serving continues through the dump.
+        Returns the committed generation number."""
+        with self._snap_lock:
+            return self._write_snapshot(path, self._capture_state())
+
+    def snapshot_async(self, path: str) -> bool:
+        """Non-blocking checkpoint-cadence snapshot: capture under the
+        locks briefly, then serialize + fsync in a background thread so
+        the learner loop never stalls on disk. Returns False (and counts
+        a skip) when a previous dump is still writing — steady progress
+        beats a pile-up of overlapping dumps."""
+        if not self._snap_lock.acquire(blocking=False):
+            self.telemetry.record_snapshot_skip()
+            return False
+        try:
+            cap = self._capture_state()
+        except BaseException:
+            self._snap_lock.release()
+            raise
+        threading.Thread(target=self._write_and_release,
+                         args=(path, cap), name="replayfeed-snapshot",
+                         daemon=True).start()
+        return True
+
+    def _write_and_release(self, path: str, cap: tuple) -> None:
+        try:
+            self._write_snapshot(path, cap)
+        except Exception:  # noqa: BLE001 — a failed background dump must
+            # not kill the process; the next cadence tick tries again
+            log.exception("async snapshot to %s failed", path)
+        finally:
+            self._snap_lock.release()
 
     def shutdown(self, path: str, drain_timeout: float = 5.0) -> None:
         """Graceful stop for a warm reboot: stop accepting, sever live
         connections (clients retry into the reboot), drain in-flight
-        dispatches, snapshot state."""
+        dispatches, snapshot state. Blocks on ``_snap_lock``, so an
+        in-flight async dump completes before the final generation."""
         self.close()
         with self._inflight_cv:
             self._inflight_cv.wait_for(lambda: self._inflight == 0,
                                        timeout=drain_timeout)
         self.snapshot(path)
 
-    def _restore(self, path: str) -> None:
+    def _reset_boot_state(self) -> None:
+        """Back out a partially applied restore so the next candidate
+        generation (or a cold boot) starts from clean counters."""
+        self.env_steps = 0
+        self.episodes = 0
+        self.returns.clear()
+        self._flush_seq = {}
+        self._params_version = 0
+        self._params_wire = None
+
+    def _load_generation(self, files: dict[str, str]) -> None:
         from distributed_deep_q_tpu.replay.persistence import load_replay
 
-        server_file, replay_file = self._snapshot_files(path)
-        if not os.path.exists(server_file):
-            return  # cold boot: first run with snapshotting enabled
-        z = np.load(server_file, allow_pickle=False)
+        z = np.load(files["server.npz"], allow_pickle=False)
         self.env_steps = int(z["env_steps"])
         self.episodes = int(z["episodes"])
         self.returns.extend(float(r) for r in z["returns"])
@@ -390,12 +510,62 @@ class ReplayFeedServer:
         wire = z["params_wire"]
         # snapshots persist the θ frame verbatim; re-stamp frames written
         # by a previous (payload-compatible) wire version so resumed
-        # actors don't reject the pull
+        # actors don't reject the pull. reframe also re-verifies the v4
+        # CRC trailer — a frame corrupt at rest fails HERE, not in actors
         self._params_wire = reframe(wire.tobytes()) if wire.size else None
-        if self.replay is not None and os.path.exists(replay_file):
-            load_replay(self.replay, replay_file)
-        log.info("warm boot from %s: env_steps=%d replay=%s θ-version=%d",
-                 path, self.env_steps,
+        if self.replay is not None and "replay.npz" in files:
+            load_replay(self.replay, files["replay.npz"])
+
+    def _restore(self, path: str) -> None:
+        """Warm boot from the newest VALID snapshot generation. Every
+        candidate is checksum-verified first; one that verifies but still
+        fails to load (schema drift, geometry mismatch) is quarantined
+        too and the walk continues. Worst case is a loud cold boot —
+        a damaged snapshot can no longer crash the reboot."""
+        store = GenerationStore(path, keep=self.snapshot_keep)
+        while True:
+            pick = store.latest_valid()
+            if pick is None:
+                break
+            gen, files, _meta = pick
+            try:
+                self._load_generation(files)
+            except Exception as e:  # noqa: BLE001 — any load failure
+                # must fall back, not kill the boot
+                self._reset_boot_state()
+                store.quarantine(gen, f"load failed: {e}")
+                continue
+            self._restored_generation = gen
+            self.telemetry.record_quarantined(store.quarantined)
+            log.info("warm boot from %s gen %d: env_steps=%d replay=%s "
+                     "θ-version=%d (%d generation(s) quarantined)",
+                     path, gen, self.env_steps,
+                     len(self.replay) if self.replay is not None else "-",
+                     self._params_version, store.quarantined)
+            return
+        self.telemetry.record_quarantined(store.quarantined)
+        # legacy flat layout (pre-generational snapshots): {path}.server.npz
+        server_file = f"{path}.server.npz"
+        replay_file = f"{path}.replay.npz"
+        if not os.path.exists(server_file):
+            if store.quarantined:
+                log.error("COLD BOOT: all %d snapshot generation(s) under "
+                          "%s failed verification", store.quarantined, path)
+            return  # cold boot: first run with snapshotting enabled
+        files = {"server.npz": server_file}
+        if os.path.exists(replay_file):
+            files["replay.npz"] = replay_file
+        try:
+            self._load_generation(files)
+        except Exception as e:  # noqa: BLE001 — truncated/corrupt legacy
+            # npz (torn write by an old build) must not crash the boot
+            self._reset_boot_state()
+            self.telemetry.record_quarantined(1)
+            log.error("COLD BOOT: legacy snapshot %s is corrupt (%s: %s)",
+                      server_file, type(e).__name__, e)
+            return
+        log.info("warm boot from legacy snapshot %s: env_steps=%d "
+                 "replay=%s θ-version=%d", path, self.env_steps,
                  len(self.replay) if self.replay is not None else "-",
                  self._params_version)
 
@@ -444,6 +614,15 @@ class ReplayFeedServer:
                     # live client reconnects through its retry policy
                     self.telemetry.record_conn_timeout()
                     self._log_error("conn deadline", e)
+                    return
+                except ChecksumError as e:
+                    # payload failed the wire-v4 CRC: structure may even
+                    # have parsed, but the bytes are not what the peer
+                    # sent — count separately (silent-corruption pressure)
+                    # and drop the conn; the client re-sends on a clean
+                    # stream and the flush-seq dedup keeps it exactly-once
+                    self.telemetry.record_checksum_error()
+                    self._log_error("checksum", e)
                     return
                 except ProtocolError as e:
                     # desynced/corrupt stream: the frame boundary is gone,

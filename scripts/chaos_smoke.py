@@ -1,6 +1,6 @@
 """Chaos smoke — prove the RPC fault-tolerance stack end to end.
 
-Three modes:
+Four modes:
 
 ``python scripts/chaos_smoke.py [num_actors] [spec]`` (default)
     Threaded actor fleet over the production wire protocol: resilient
@@ -18,6 +18,17 @@ Three modes:
     (the shed flush re-stages under its original ``flush_seq``), sheds
     actually fired, and the clients' token buckets paced to the granted
     credits. Chaos delays compose on top via the optional spec.
+
+``python scripts/chaos_smoke.py durability [cycles] [spec]``
+    Crash-recovery acceptance (ISSUE 6): the server is hard-killed at
+    random points across the snapshot cadence over ≥ 20 cycles — before,
+    during (async dump in flight), and after commits — under ``torn=``
+    disk damage and ``corrupt=`` wire flips, with fabricated
+    crashed-before-commit generation directories thrown in. The gate:
+    every warm boot lands exactly on the newest generation that verifies
+    clean (checked against an independent pre-boot probe), and after
+    actors replay their full labeled history through the flush-seq dedup
+    there are zero lost, zero duplicated, and zero corrupt rows.
 
 ``python scripts/chaos_smoke.py train [cfg.overrides ...]``
     The full distributed trainer (spawned actor processes, mesh learner)
@@ -241,6 +252,165 @@ def run_overload_smoke(num_actors: int = 3, flushes: int = 40, rows: int = 16,
     return verdict
 
 
+def run_durability_smoke(cycles: int = 20, num_actors: int = 3,
+                         flushes_per_cycle: int = 4, rows: int = 8,
+                         spec: str = "torn=0.35,corrupt=0.03,seed=23",
+                         keep: int = 4) -> dict:
+    """Kill/warm-boot loop under torn-write + wire-corruption chaos.
+
+    Single-threaded by design: every flush is sequenced by the harness
+    itself (manual ``flush_seq`` per actor), so "what must be in replay"
+    is exact. After each hard kill the actors re-send their FULL history
+    in original order — the flush-seq dedup absorbs everything the
+    restored generation already holds, the gap lands exactly once, and
+    any divergence is a real durability bug, not harness noise."""
+    from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
+    from distributed_deep_q_tpu.rpc import faultinject
+    from distributed_deep_q_tpu.rpc.replay_server import (
+        ReplayFeedClient, ReplayFeedServer)
+    from distributed_deep_q_tpu.utils.durability import GenerationStore
+
+    plan = faultinject.install(spec)
+    rng = np.random.default_rng(23)
+    snap = tempfile.mktemp(prefix="durability_smoke_")
+    total = cycles * num_actors * flushes_per_cycle * rows
+    cap = max(2 * total, 1024)
+
+    history: dict[int, list] = {a: [] for a in range(num_actors)}
+    expected: set[int] = set()
+    errors: list[str] = []
+    boot_mismatches: list[str] = []
+    quarantined_total = checksum_total = snapshots_landed = 0
+
+    replay = ReplayMemory(cap, (2,), np.float32, seed=0)
+    server = ReplayFeedServer(replay, snapshot_path=snap, snapshot_keep=keep)
+
+    def clients() -> list:
+        host, port = server.address
+        return [ReplayFeedClient(host, port, actor_id=a, timeout=5.0)
+                for a in range(num_actors)]
+
+    def push(c, seq: int, obs: np.ndarray) -> None:
+        n = len(obs)
+        for _ in range(200):
+            try:
+                resp = c.call(
+                    "add_transitions", flush_seq=seq, obs=obs, next_obs=obs,
+                    action=np.zeros(n, np.int32),
+                    reward=np.zeros(n, np.float32),
+                    discount=np.ones(n, np.float32))
+            except Exception:  # noqa: BLE001 — chaos; reconnect + retry
+                time.sleep(0.005)
+                continue
+            if resp.get("error") or resp.get("shed"):
+                time.sleep(0.01)
+                continue
+            return
+        raise RuntimeError(f"flush seq {seq} never landed")
+
+    def probe_newest_valid():
+        """Side-effect-free answer to "which generation SHOULD the next
+        warm boot restore?" — same verification the server runs, but
+        without quarantining, so it cannot influence the boot it checks."""
+        store = GenerationStore(snap, keep=keep)
+        for gen in reversed(store.generations()):
+            try:
+                _, meta = store.verify(gen)
+                return gen, meta
+            except Exception:  # noqa: BLE001 — damaged gen, keep walking
+                continue
+        return None
+
+    seqs = [0] * num_actors
+    t0 = time.perf_counter()
+    for cycle in range(cycles):
+        cs = clients()
+        for _ in range(flushes_per_cycle):
+            for a, c in enumerate(cs):
+                seq = seqs[a]
+                ids = (a * 1_000_000 + seq * 1_000
+                       + np.arange(rows, dtype=np.float32))
+                obs = np.stack([ids, ids], axis=1)
+                push(c, seq, obs)
+                history[a].append((seq, obs))
+                expected.update(int(i) for i in ids)
+                seqs[a] += 1
+        # kill point roulette: after a sync commit / racing an async dump
+        # / before any snapshot this cycle ran
+        roll = rng.random()
+        if roll < 0.45:
+            server.snapshot(snap)
+            snapshots_landed += 1
+        elif roll < 0.75:
+            if server.snapshot_async(snap):
+                snapshots_landed += 1
+            if rng.random() < 0.5:
+                time.sleep(float(rng.random()) * 0.02)
+        if rng.random() < 0.3:
+            # crash-before-commit: a generation directory with payload
+            # bytes but no manifest must be skipped by restore
+            store = GenerationStore(snap, keep=keep)
+            gens = store.generations()
+            part = os.path.join(
+                snap, f"gen-{(gens[-1] + 1 if gens else 0):08d}")
+            os.makedirs(part, exist_ok=True)
+            with open(os.path.join(part, "server.npz"), "wb") as f:
+                f.write(bytes(rng.integers(0, 256, 64, dtype=np.uint8)))
+        for c in cs:
+            c.close()
+        server.close()  # hard kill (no shutdown-snapshot)
+        server._snap_lock.acquire()  # join any in-flight async write
+        server._snap_lock.release()
+        checksum_total += \
+            server.telemetry.robustness_counters()["checksum_errors"]
+
+        pick = probe_newest_valid()
+        replay = ReplayMemory(cap, (2,), np.float32, seed=0)
+        server = ReplayFeedServer(replay, snapshot_path=snap,
+                                  snapshot_keep=keep)
+        quarantined_total += \
+            server.telemetry.robustness_counters()["snapshot_quarantined"]
+        got = server.counters()["env_steps"]
+        want = int(pick[1]["env_steps"]) if pick else 0
+        if got != want or (pick and server._restored_generation != pick[0]):
+            boot_mismatches.append(
+                f"cycle {cycle}: booted env_steps={got} "
+                f"gen={server._restored_generation}, probe says {pick}")
+
+        cs = clients()
+        for a, c in enumerate(cs):
+            for seq, obs in history[a]:
+                push(c, seq, obs)
+        observed = replay.obs[:len(replay), 0].astype(np.int64).tolist()
+        lost = len(expected - set(observed))
+        duplicated = len(observed) - len(set(observed))
+        corrupt_rows = len(set(observed) - expected)
+        if lost or duplicated or corrupt_rows:
+            errors.append(f"cycle {cycle}: lost={lost} dup={duplicated} "
+                          f"corrupt_rows={corrupt_rows}")
+        for c in cs:
+            c.close()
+
+    wall = time.perf_counter() - t0
+    server.close()
+    faultinject.uninstall()
+    verdict = {
+        "ok": not errors and not boot_mismatches,
+        "cycles": cycles,
+        "num_actors": num_actors,
+        "transitions_sent": total,
+        "snapshots_landed": snapshots_landed,
+        "generations_quarantined": quarantined_total,
+        "wire_checksum_rejections": checksum_total,
+        "torn_writes_fired": plan.counters.get("file/torn", 0),
+        "boot_mismatches": boot_mismatches,
+        "errors": errors,
+        "chaos_spec": spec,
+        "wall_s": round(wall, 2),
+    }
+    return verdict
+
+
 def run_train_chaos(argv: list[str]) -> dict:
     import jax
 
@@ -296,6 +466,15 @@ if __name__ == "__main__":
     if args and args[0] == "train":
         print(json.dumps(run_train_chaos(args[1:]), default=str))
         sys.exit(0)
+    if args and args[0] in ("durability", "--durability"):
+        kwargs = {}
+        if len(args) > 1 and args[1].isdigit():
+            kwargs["cycles"] = int(args[1])
+        if len(args) > 2:
+            kwargs["spec"] = args[2]
+        verdict = run_durability_smoke(**kwargs)
+        print(json.dumps(verdict))
+        sys.exit(0 if verdict["ok"] else 1)
     if args and args[0] in ("overload", "--overload"):
         verdict = run_overload_smoke(
             spec=args[1] if len(args) > 1 else "delay=0.05:20,seed=13")

@@ -234,6 +234,20 @@ def render_report(records: list[dict], last: int = 0) -> str:
             rows.append((key, vals[-1], min(vals), max(vals)))
     _table("queue gauges", rows, ("gauge", "last", "min", "max"), out)
 
+    # durability plane: snapshot cadence/stall/size, generation retention,
+    # quarantines, and wire CRC rejections (any nonzero quarantine or
+    # checksum count deserves a look — it means damage was absorbed)
+    rows = []
+    for key in sorted({k for r in records for k in r
+                       if k.startswith("durability/")
+                       or k == "rpc/checksum_errors"}):
+        vals = [v for v in _series(records, key)
+                if isinstance(v, (int, float))]
+        if vals:
+            rows.append((key, vals[-1], min(vals), max(vals)))
+    _table("durability (snapshots & integrity)", rows,
+           ("gauge", "last", "min", "max"), out)
+
     problems = validate_records(records) + _gap_anomalies(records)
     out.append(f"\n== anomalies ({len(problems)}) ==")
     for p in problems[:50]:

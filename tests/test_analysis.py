@@ -13,7 +13,7 @@ import pytest
 
 from distributed_deep_q_tpu.analysis import repo_root, run_all
 from distributed_deep_q_tpu.analysis import (
-    config_keys, locks, protocol_drift, purity)
+    atomic_writes, config_keys, locks, protocol_drift, purity)
 from distributed_deep_q_tpu.analysis.core import Source
 
 
@@ -341,6 +341,69 @@ def test_config_schema_parsed_from_real_config():
     assert set(schema) == {"net", "replay", "train", "env", "actors", "mesh"}
     assert "num_actions" in schema["net"]
     assert "server_snapshot_path" in schema["train"]
+
+
+# ---------------------------------------------------------------------------
+# atomic-write discipline
+# ---------------------------------------------------------------------------
+
+
+def test_atomic_writes_raw_binary_sinks_caught():
+    findings = atomic_writes.check_sources([src("""
+        import pickle
+        import numpy as np
+
+        def dump(path, arr, state):
+            with open(path, "wb") as f:          # raw binary write
+                f.write(arr.tobytes())
+            np.savez(path, **state)              # savez to a real path
+            arr.tofile(path)                     # unbuffered raw write
+            with open(path, "wb") as f:
+                pickle.dump(state, f)            # banned on persisted paths
+    """)])
+    assert rules(findings) == {atomic_writes.RULE}
+    assert len(findings) == 5  # two opens, savez, tofile, pickle.dump
+
+
+def test_atomic_writes_reads_text_and_memory_sinks_clean():
+    findings = atomic_writes.check_sources([src("""
+        import io
+        import numpy as np
+
+        def fine(path, state, log_line):
+            with open(path, "rb") as f:          # binary READ
+                blob = f.read()
+            with open(path + ".jsonl", "a") as f:  # text append (metrics)
+                f.write(log_line)
+            np.savez(io.BytesIO(), **state)      # in-memory serialize
+            buf = io.BytesIO()
+            np.savez(buf, **state)               # named memory sink
+            return blob
+    """)])
+    assert findings == []
+
+
+def test_atomic_writes_nonliteral_mode_skipped_pragma_works():
+    findings = atomic_writes.check_sources([src("""
+        def edge(path, mode, blob):
+            with open(path, mode) as f:          # non-literal mode: skipped
+                f.write(blob)
+            with open(path, "wb") as f:  # ddq: allow(durability.raw-write)
+                f.write(blob)
+    """)])
+    assert findings == []
+
+
+def test_atomic_writes_durability_module_is_exempt():
+    bad = """
+        def primitive(path, blob):
+            with open(path, "wb") as f:
+                f.write(blob)
+    """
+    assert atomic_writes.check_sources(
+        [src(bad, atomic_writes.EXEMPT_FILES[0])]) == []
+    assert len(atomic_writes.check_sources(
+        [src(bad, "distributed_deep_q_tpu/other.py")])) == 1
 
 
 # ---------------------------------------------------------------------------

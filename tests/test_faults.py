@@ -22,7 +22,8 @@ import pytest
 from distributed_deep_q_tpu.rpc import faultinject
 from distributed_deep_q_tpu.rpc.faultinject import ChaosPlan, ChaosSocket
 from distributed_deep_q_tpu.rpc.protocol import (
-    HEADER_SIZE, ProtocolError, decode, encode, recv_msg)
+    HEADER_SIZE, TRAILER_SIZE, ChecksumError, ProtocolError, decode, encode,
+    recv_msg)
 from distributed_deep_q_tpu.rpc.replay_server import (
     ReplayFeedClient, ReplayFeedServer)
 from distributed_deep_q_tpu.rpc.resilience import (
@@ -257,14 +258,14 @@ def _rich_msg() -> dict:
 
 
 def test_every_truncation_raises_protocol_error():
-    payload = encode(_rich_msg())[HEADER_SIZE:]
+    payload = encode(_rich_msg())[HEADER_SIZE:-TRAILER_SIZE]
     for cut in range(len(payload)):
         with pytest.raises(ProtocolError):
             decode(payload[:cut])
 
 
 def test_bitflip_fuzz_never_escapes_protocol_error():
-    payload = encode(_rich_msg())[HEADER_SIZE:]
+    payload = encode(_rich_msg())[HEADER_SIZE:-TRAILER_SIZE]
     rng = np.random.default_rng(0)
     survived = 0
     for _ in range(500):
@@ -303,7 +304,7 @@ def test_roundtrip_random_messages():
                           rng.integers(0, 4, size=int(rng.integers(0, 3))))
             dt = dtypes[int(rng.integers(len(dtypes)))]
             msg[f"a{k}"] = np.asarray((rng.random(shape) * 100).astype(dt))
-        out = decode(encode(msg)[HEADER_SIZE:])
+        out = decode(encode(msg)[HEADER_SIZE:-TRAILER_SIZE])
         assert out["trial"] == trial and out["tag"] == f"t{trial}"
         for k, v in msg.items():
             if isinstance(v, np.ndarray):
@@ -321,6 +322,49 @@ def test_recv_rejects_bad_magic():
     finally:
         a.close()
         b.close()
+
+
+def test_recv_catches_every_payload_bitflip():
+    """Wire v4 acceptance: ANY single-bit flip in the payload region of a
+    frame in transit must be caught by the CRC-32C trailer — including the
+    flips inside array data that decode() alone cannot see."""
+    frame = encode(_rich_msg())
+    rng = np.random.default_rng(3)
+    for _ in range(200):
+        buf = bytearray(frame)
+        i = HEADER_SIZE + int(rng.integers(len(frame) - HEADER_SIZE
+                                           - TRAILER_SIZE))
+        buf[i] ^= 1 << int(rng.integers(8))
+        a, b = socket.socketpair()
+        try:
+            a.sendall(bytes(buf))
+            b.settimeout(5)
+            with pytest.raises(ChecksumError):
+                recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+
+def test_recv_catches_trailer_and_fullframe_damage():
+    """Flips anywhere in the frame — header, payload, or the trailer
+    itself — must never be silently accepted: each lands as ChecksumError,
+    ProtocolError, or a dropped connection."""
+    frame = encode(_rich_msg())
+    rng = np.random.default_rng(9)
+    for _ in range(200):
+        buf = bytearray(frame)
+        i = int(rng.integers(len(frame)))
+        buf[i] ^= 1 << int(rng.integers(8))
+        a, b = socket.socketpair()
+        try:
+            a.sendall(bytes(buf))
+            a.close()  # EOF after the damaged frame
+            b.settimeout(5)
+            with pytest.raises((ProtocolError, ConnectionError)):
+                recv_msg(b)  # ChecksumError is a ProtocolError
+        finally:
+            b.close()
 
 
 # ---------------------------------------------------------------------------
@@ -575,8 +619,8 @@ def _chaos_fleet_run(feed_server, tmp_path, n_actors, n_flushes, rows,
 @pytest.mark.chaos
 def test_chaos_restart_zero_loss_zero_duplicates(feed_server, tmp_path):
     # drop + truncate exercise every ambiguous failure mode; corrupt is
-    # deliberately OFF here — a bit flip inside array data is undetectable
-    # by design (no checksum) and would perturb the labels themselves
+    # kept OFF here so this case isolates the connection-loss paths (the
+    # corrupt-ON variant below covers bit flips, caught by the wire-v4 CRC)
     plan, replay2, server2, errors, expected, observed = _chaos_fleet_run(
         feed_server, tmp_path, n_actors=3, n_flushes=20, rows=4,
         spec="drop=0.03,truncate=0.02,seed=11")
@@ -585,6 +629,22 @@ def test_chaos_restart_zero_loss_zero_duplicates(feed_server, tmp_path):
     assert plan.total_faults() > 0, "chaos plan never fired"
     # env_steps survived the reboot and matches the deduped insert count
     assert server2.env_steps == len(expected)
+
+
+@pytest.mark.chaos
+def test_chaos_corrupt_flips_never_poison_replay(feed_server, tmp_path):
+    """Bit flips in transit used to be the one undetectable fault — the
+    wire-v4 CRC-32C trailer makes them loud. Under active corruption the
+    fleet must still land EXACTLY the expected labels: every flip is
+    rejected (ChecksumError → reconnect → idempotent resend), never
+    silently inserted as a poisoned row."""
+    plan, replay2, server2, errors, expected, observed = _chaos_fleet_run(
+        feed_server, tmp_path, n_actors=3, n_flushes=20, rows=4,
+        spec="corrupt=0.04,seed=17")
+    assert not errors, f"silent/failed actors: {errors}"
+    assert sorted(observed) == sorted(expected)  # zero poisoned rows
+    flips = sum(v for k, v in plan.counters.items() if k.endswith("/corrupt"))
+    assert flips > 0, "no flips were injected"
 
 
 @pytest.mark.chaos

@@ -21,8 +21,8 @@ from distributed_deep_q_tpu.rpc import faultinject, flowcontrol
 from distributed_deep_q_tpu.rpc.flowcontrol import (
     FlowConfig, FlowController, TokenBucket, rss_mb)
 from distributed_deep_q_tpu.rpc.protocol import (
-    HEADER_SIZE, ProtocolError, WIRE_VERSION, _HEADER, MAGIC, decode,
-    encode, reframe)
+    HEADER_SIZE, ProtocolError, TRAILER_SIZE, WIRE_VERSION, _HEADER, MAGIC,
+    decode, encode, reframe)
 from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedServer
 from distributed_deep_q_tpu.rpc.resilience import (
     ResilientReplayFeedClient, RetryPolicy)
@@ -319,12 +319,15 @@ def test_rss_mb_reads_something_on_linux():
 
 def test_reframe_restamps_compatible_version():
     frame = encode({"version": 4, "w0": np.ones(3, np.float32), "n": 1})
-    v2 = _HEADER.pack(MAGIC, 2, len(frame) - HEADER_SIZE) \
-        + frame[HEADER_SIZE:]
+    payload = frame[HEADER_SIZE:-TRAILER_SIZE]
+    # pre-trailer snapshot frames (v2/v3) carry payload only; reframe
+    # must restamp them to the full v4 geometry — header + CRC trailer
+    v2 = _HEADER.pack(MAGIC, 2, len(payload)) + payload
     out = reframe(v2)
     _, version, _ = _HEADER.unpack_from(out)
     assert version == WIRE_VERSION
-    msg = decode(out[HEADER_SIZE:])  # payload bytes untouched
+    assert out == frame  # byte-identical to a fresh v4 encode
+    msg = decode(out[HEADER_SIZE:-TRAILER_SIZE])  # payload bytes untouched
     assert msg["version"] == 4 and msg["n"] == 1
     np.testing.assert_array_equal(msg["w0"], np.ones(3, np.float32))
     assert reframe(frame) is frame  # current version passes through
@@ -332,8 +335,8 @@ def test_reframe_restamps_compatible_version():
 
 def test_reframe_rejects_incompatible_or_damaged():
     frame = encode({"a": 1})
-    v1 = _HEADER.pack(MAGIC, 1, len(frame) - HEADER_SIZE) \
-        + frame[HEADER_SIZE:]
+    payload = frame[HEADER_SIZE:-TRAILER_SIZE]
+    v1 = _HEADER.pack(MAGIC, 1, len(payload)) + payload
     with pytest.raises(ProtocolError):
         reframe(v1)  # unknown payload format → loud failure
     with pytest.raises(ProtocolError):
@@ -342,6 +345,10 @@ def test_reframe_rejects_incompatible_or_damaged():
         reframe(b"\x00" + frame[1:])  # bad magic
     with pytest.raises(ProtocolError):
         reframe(frame + b"xx")  # length disagreement
+    corrupt = bytearray(frame)
+    corrupt[HEADER_SIZE] ^= 0x40  # payload damaged at rest
+    with pytest.raises(ProtocolError):  # ChecksumError is a ProtocolError
+        reframe(bytes(corrupt))
 
 
 # ---------------------------------------------------------------------------

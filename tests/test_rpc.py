@@ -11,7 +11,7 @@ import numpy as np
 import pytest
 
 from distributed_deep_q_tpu.rpc.protocol import (
-    HEADER_SIZE, decode, encode, recv_msg, send_msg)
+    HEADER_SIZE, TRAILER_SIZE, decode, encode, recv_msg, send_msg)
 from distributed_deep_q_tpu.rpc.replay_server import (
     ReplayFeedClient, ReplayFeedServer)
 from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
@@ -29,7 +29,7 @@ def test_protocol_roundtrip_types():
         "a_bool": True,
         "nothing": None,
     }
-    out = decode(encode(msg)[HEADER_SIZE:])
+    out = decode(encode(msg)[HEADER_SIZE:-TRAILER_SIZE])
     assert set(out) == set(msg)
     for k in ("arr_u8", "arr_f32", "arr_bool", "arr_scalar"):
         np.testing.assert_array_equal(out[k], msg[k])
