@@ -141,6 +141,8 @@ import time
 
 import numpy as np
 
+from distributed_deep_q_tpu import tracing
+
 BATCH = 512
 CAFFE_STEPS_PER_S = 100.0            # documented estimate, batch 32
 CAFFE_TRANSITIONS_PER_S = 3200.0     # = 100 steps/s * batch 32
@@ -509,8 +511,11 @@ def run_writers(replay, lock: threading.Lock, stop: threading.Event,
             done[-1] = (t % 10 == 9)  # an episode boundary every ~10 chunks
             payload = {"frame": frames, "action": np.zeros(chunk, np.int32),
                        "reward": np.ones(chunk, np.float32), "done": done}
-            with lock:
-                replay.add_batch(payload, stream=stream)
+            # tracing.locked splits lock_wait (contention against the
+            # learner's sample+dispatch hold) from the insert itself
+            with tracing.locked(lock):
+                with tracing.span("ring_insert"):
+                    replay.add_batch(payload, stream=stream)
                 probe = getattr(replay, "dstate", None)
             if t % 4 == 3 and probe is not None:
                 # bound the IN-FLIGHT flush queue, not just staged rows:
@@ -670,6 +675,94 @@ def bench_r2d2(cfg_mod, on_cpu: bool, out: dict) -> None:
     del dev, solver
 
 
+def trace_ingest(cfg_mod, on_cpu: bool) -> None:
+    """Ingest-attribution mode (``--trace-ingest``): run a flagship-shaped
+    learner under paced writer ingest with the tracer at sample_rate=1,
+    export the Perfetto shard, and emit a per-stage SELF-time breakdown
+    alongside the achieved rates. Answers "where does an ingested
+    transition's wall time go" with measured spans instead of inferred
+    subtraction (PERF.md §10). Prints its own one-JSON-line result —
+    the full suite does not run in this mode."""
+    import sys
+
+    def note(msg):
+        print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+    tracing.configure(enabled=True, sample_rate=1.0, lineage_rate=0.2,
+                      buffer_spans=1 << 16, export_dir="traces")
+
+    # CPU shape is a deliberately tiny smoke: with one CPU device the
+    # nature_cnn chain executes quasi-synchronously inside the dispatch,
+    # so flagship-sized steps would serialize the whole window into one
+    # lock_hold. The accelerator shape matches the flagship bench.
+    batch = 32 if on_cpu else BATCH
+    chain = 2 if on_cpu else 32  # flagship's chain cap (staging vs 1M ring)
+    writers = 2 if on_cpu else 4
+    note("trace_ingest: build + prefill")
+    solver, replay = build(cfg_mod, capacity=16_384 if on_cpu else 65_536,
+                           batch=batch, prioritized=True, pallas=False,
+                           device_per=True, num_streams=writers,
+                           prefill=4_096 if on_cpu else 20_000)
+    lock = threading.Lock()
+
+    def one_step():
+        # the inner sample/train_step spans come from the learner's
+        # host-dispatch instrumentation (parallel/learner.py)
+        with tracing.locked(lock):
+            solver.train_steps_device_per(replay, chain=chain)
+
+    note("trace_ingest: warmup/compile")
+    for _ in range(2):
+        one_step()
+    _fence(solver)
+    tracing.drain()  # compile+warmup spans must not enter the attribution
+
+    stop = threading.Event()
+    counter = [0] * writers
+    threads = run_writers(replay, lock, stop, counter, writers,
+                          total_rate=INGEST_TARGET)
+    c0 = sum(counter)
+    note("trace_ingest: timed window")
+    window_s = 3.0 if on_cpu else 8.0
+    t0 = time.perf_counter()
+    steps = 0
+    while time.perf_counter() - t0 < window_s:
+        one_step()
+        steps += chain
+    _fence(solver)  # completion, not enqueue (module docstring)
+    wall = time.perf_counter() - t0
+    ingest = (sum(counter) - c0) / wall
+    stop.set()
+    for th in threads:
+        th.join(timeout=10.0)
+
+    path = tracing.export()  # drains the rings into the Perfetto shard
+    dropped = tracing.drop_count()
+    events = []
+    if path:
+        with open(path) as fh:
+            events = [e for e in json.load(fh)["traceEvents"]
+                      if e.get("ph") == "X"]
+        print(tracing.attribution_table(events, wall_s=wall),
+              file=sys.stderr, flush=True)
+    stage_ms: dict[str, float] = {}
+    for per_thread in tracing.self_times(events).values():
+        for name, us in per_thread["stages"].items():
+            stage_ms[name] = stage_ms.get(name, 0.0) + us / 1e3
+    tracing.disable()
+
+    print(json.dumps({
+        "metric": "ingest_attribution",
+        "wall_s": round(wall, 3),
+        "steps_per_s": round(steps / wall, 2),
+        "achieved_t_per_s": round(ingest, 1),
+        "trace_path": path,
+        "spans_dropped": dropped,
+        "stage_self_ms": {k: round(v, 3)
+                          for k, v in sorted(stage_ms.items())},
+    }))
+
+
 def main() -> None:
     import jax
 
@@ -685,6 +778,12 @@ def main() -> None:
     from distributed_deep_q_tpu import config as cfg_mod
 
     on_cpu = jax.devices()[0].platform == "cpu"
+
+    import sys
+    if "--trace-ingest" in sys.argv:
+        trace_ingest(cfg_mod, on_cpu)
+        return
+
     # CPU fallback sizes keep local runs tractable; the driver runs on TPU
     # with the full flagship shapes.
     flag_cap = 131_072 if on_cpu else 1_000_000

@@ -26,6 +26,7 @@ from typing import Any
 
 import numpy as np
 
+from distributed_deep_q_tpu import tracing
 from distributed_deep_q_tpu.config import Config
 from distributed_deep_q_tpu.metrics import Metrics
 
@@ -258,13 +259,14 @@ class _ActorComms:
         if stale and not due:
             self.lag_blocks += 1
         t0 = time.perf_counter()
-        version, weights = self._client.get_params(
-            have_version=self._version)
-        # time the full round trip incl. installing fresh weights —
-        # that is the latency the env loop actually pays
-        if weights is not None:
-            self._qnet.set_weights(weights)
-            self._version = version
+        with tracing.span("param_pull"):
+            version, weights = self._client.get_params(
+                have_version=self._version)
+            # time the full round trip incl. installing fresh weights —
+            # that is the latency the env loop actually pays
+            if weights is not None:
+                self._qnet.set_weights(weights)
+                self._version = version
         self._pull_ms.append(1e3 * (time.perf_counter() - t0))
 
     def drain_telemetry(self) -> dict[str, np.ndarray]:
@@ -294,6 +296,9 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     local θ copy.
     """
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # tracing config rides the pickled cfg into the spawned child; spans
+    # from this process export as their own shard (trace-<pid>.json)
+    tracing.configure_from(cfg.trace)
     # The env var alone is NOT enough on hosts whose sitecustomize
     # pre-imports jax with an accelerator platform pinned: jax latches
     # the env into its config default AT IMPORT, so a spawned actor that
@@ -356,6 +361,9 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
                               ("frame", "action", "reward", "done", "boundary",
                                "obs", "next_obs", "discount")}
     ep_returns: list[float] = []
+    # per-row birth stamps (lineage plane) — only populated while tracing
+    # is enabled, so the disabled path never touches the list
+    births: list[float] = []
     episodes = 0
     steps = 0
 
@@ -385,6 +393,13 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
         step_ms = env.drain_step_ms()
         if step_ms:
             payload["tm_env_step_ms"] = np.asarray(step_ms, np.float32)
+        if births:
+            if tracing.lineage_sample():
+                # birth stamps ship pre-corrected to the SERVER clock so
+                # the server's age math needs no per-actor skew state
+                payload[tracing.KEY_BIRTH] = tracing.to_server_clock(
+                    np.asarray(births, np.float64))
+            births.clear()
         resp = client.add_transitions(**payload)
         comms.note_published(resp.get("params_version"))
         for v in chunk.values():
@@ -411,7 +426,8 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
                 a = int(rng.integers(env.num_actions))
             else:
                 a = qnet.argmax_action(np.asarray(obs))
-            next_frame, r, done, over = env.step(a)
+            with tracing.span_sampled("env_step"):
+                next_frame, r, done, over = env.step(a)
             ep_ret += r
             steps += 1
 
@@ -421,6 +437,8 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
                 chunk["reward"].append(r)
                 chunk["done"].append(done)
                 chunk["boundary"].append(over)
+                if tracing.ENABLED:
+                    births.append(tracing.now())
                 frame = next_frame
                 obs = stacker.push(frame)
             else:
@@ -433,6 +451,8 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
                     chunk["reward"].append(rw)
                     chunk["next_obs"].append(no)
                     chunk["discount"].append(disc)
+                    if tracing.ENABLED:
+                        births.append(tracing.now())
                 obs = next_frame
 
             if over:
@@ -454,6 +474,8 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     finally:
         comms.close()
         client.close()
+        if tracing.ENABLED:
+            tracing.export()
 
 
 def _recurrent_actor_loop(cfg: Config, env, qnet, client, rng, eps: float,
@@ -483,6 +505,7 @@ def _recurrent_actor_loop(cfg: Config, env, qnet, client, rng, eps: float,
 
     seqs: list[dict] = []
     ep_returns: list[float] = []
+    births: list[float] = []  # per-env-step birth stamps (tracing only)
     episodes = 0
     env_steps_since = 0
     steps = 0
@@ -499,6 +522,14 @@ def _recurrent_actor_loop(cfg: Config, env, qnet, client, rng, eps: float,
         step_ms = getattr(env, "drain_step_ms", lambda: [])()
         if step_ms:
             payload["tm_env_step_ms"] = np.asarray(step_ms, np.float32)
+        if births:
+            if tracing.lineage_sample():
+                # rows ≠ ring slots for overlapping sequences, so the
+                # server folds these into the flush-level ingest-lag
+                # histogram only (no per-slot lineage mapping)
+                payload[tracing.KEY_BIRTH] = tracing.to_server_clock(
+                    np.asarray(births, np.float64))
+            births.clear()
         resp = client.add_transitions(**payload)
         comms.note_published(resp.get("params_version"))
         seqs.clear()
@@ -524,11 +555,14 @@ def _recurrent_actor_loop(cfg: Config, env, qnet, client, rng, eps: float,
                 a = int(rng.integers(env.num_actions))
             else:
                 a = int(np.argmax(np.asarray(q)[0, 0]))
-            next_frame, r, done, over = env.step(a)
+            with tracing.span_sampled("env_step"):
+                next_frame, r, done, over = env.step(a)
             next_obs = stacker.push(next_frame) if pixel else next_frame
             ep_ret += r
             steps += 1
             env_steps_since += 1
+            if tracing.ENABLED:
+                births.append(tracing.now())
             seqs.extend(builder.on_step(
                 obs, a, r, done,
                 (np.asarray(carry_before[0])[0],
@@ -557,6 +591,8 @@ def _recurrent_actor_loop(cfg: Config, env, qnet, client, rng, eps: float,
     finally:
         comms.close()
         client.close()
+        if tracing.ENABLED:
+            tracing.export()
 
 
 # ---------------------------------------------------------------------------
@@ -723,6 +759,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     from distributed_deep_q_tpu.solver import Solver
 
     metrics = metrics or Metrics()
+    tracing.configure_from(cfg.trace)  # learner-process tracer state
     probe = _probe_envs(cfg)
     cfg.net.num_actions = probe.num_actions
     obs_shape = probe.obs_shape
@@ -856,6 +893,15 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                         with timer.phase("sample"):
                             batch = replay.sample(local_batch)
                 sampled_at = batch.pop("_sampled_at", replay.steps_added)
+                if tracing.ENABLED and isinstance(batch.get("index"),
+                                                  np.ndarray):
+                    # lineage lookup at CONSUMPTION: env-step birth →
+                    # this gradient step = time_to_learn (host-indexed
+                    # tiers only; device tiers keep ingest-lag coverage)
+                    ages = server.lineage_ages(batch["index"])
+                    if ages.size:
+                        metrics.observe_many("learner/time_to_learn_ms",
+                                             ages * 1e3)
                 with timer.phase("dispatch"):
                     m = solver.train_step(batch)
             metrics.count("grad_steps")
@@ -909,6 +955,8 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
         if stager is not None:
             stager.close()
         _tear_down_rpc_plane(cfg, server, sup)
+        if tracing.ENABLED:
+            tracing.export()  # learner-process shard (actors wrote theirs)
 
     summary["final_return_avg100"] = server.mean_recent_return()
     if writeback:
@@ -948,6 +996,7 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
     from distributed_deep_q_tpu.utils.checkpoint import maybe_checkpointer
 
     metrics = metrics or Metrics()
+    tracing.configure_from(cfg.trace)  # learner-process tracer state
     probe = _probe_envs(cfg)
     cfg.net.num_actions = probe.num_actions
     pixel = probe.obs_dtype == np.uint8
@@ -1051,6 +1100,12 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
                     with timer.phase("sample"):
                         batch = replay.sample(local_batch)
                     sampled_at = batch.pop("_sampled_at")
+                if tracing.ENABLED and isinstance(batch.get("index"),
+                                                  np.ndarray):
+                    ages = server.lineage_ages(batch["index"])
+                    if ages.size:
+                        metrics.observe_many("learner/time_to_learn_ms",
+                                             ages * 1e3)
                 with timer.phase("dispatch"):
                     m = solver.train_step(batch)
             metrics.count("grad_steps")
@@ -1090,6 +1145,8 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
                             **metrics.telemetry())
     finally:
         _tear_down_rpc_plane(cfg, server, sup)
+        if tracing.ENABLED:
+            tracing.export()  # learner-process shard (actors wrote theirs)
 
     summary["final_return_avg100"] = server.mean_recent_return()
     if writeback:

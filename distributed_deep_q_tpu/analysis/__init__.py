@@ -1,7 +1,7 @@
 """Repo-native static analysis — machine-checked concurrency/JAX/RPC
 invariants.
 
-Five passes, one entry point:
+Six passes, one entry point:
 
 - ``locks``          — guarded-attribute lock discipline + static
                        lock-order deadlock detection
@@ -9,6 +9,8 @@ Five passes, one entry point:
 - ``protocol_drift`` — RPC client/server/wire skew
 - ``config_keys``    — ``cfg.<section>.<field>`` existence
 - ``atomic_writes``  — raw binary writes bypassing the durability plane
+- ``metric_keys``    — metric names vs the declared registry; span
+                       names vs the tracer's stage tables
 
 ``run_all(repo_root)`` returns every finding; ``scripts/analysis_gate.py``
 is the CLI gate (exit non-zero on findings) and a tier-1 test keeps the
@@ -22,7 +24,7 @@ import os
 
 from distributed_deep_q_tpu.analysis.core import Finding, Source
 from distributed_deep_q_tpu.analysis import (  # noqa: F401
-    atomic_writes, config_keys, locks, protocol_drift, purity)
+    atomic_writes, config_keys, locks, metric_keys, protocol_drift, purity)
 
 __all__ = ["Finding", "Source", "run_all", "repo_root"]
 
@@ -41,4 +43,5 @@ def run_all(root: str | None = None) -> list[Finding]:
     findings += protocol_drift.check(root)
     findings += config_keys.check(root)
     findings += atomic_writes.check(root)
+    findings += metric_keys.check(root)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

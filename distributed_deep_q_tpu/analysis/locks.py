@@ -80,6 +80,10 @@ DEFAULT_REGISTRY = LockRegistry(
                                   ("self", "server")),
         "_flush_seq":       Guard("replay_lock", "ReplayFeedServer",
                                   ("self", "server")),
+        # lineage stamps (tracing plane): slot → (birth, insert position)
+        # moves with the ring inserts it describes
+        "_lineage":         Guard("replay_lock", "ReplayFeedServer",
+                                  ("self", "server")),
         # published θ frame
         "_params_wire":     Guard("_params_lock", "ReplayFeedServer"),
         "_params_version":  Guard("_params_lock", "ReplayFeedServer"),
@@ -112,6 +116,8 @@ DEFAULT_REGISTRY = LockRegistry(
         "actor_sheds":      Guard("_lock", "ServerTelemetry",
                                   ("self", "server.telemetry")),
         "conn_timeouts":    Guard("_lock", "ServerTelemetry",
+                                  ("self", "server.telemetry")),
+        "ingest_lag":       Guard("_lock", "ServerTelemetry",
                                   ("self", "server.telemetry")),
         # durability plane gauges (ISSUE 6): CRC rejections + snapshot
         # cadence/stall/quarantine counters
@@ -191,7 +197,15 @@ class _Walker(ast.NodeVisitor):
     def visit_With(self, node: ast.With) -> None:
         taken: list[str] = []
         for item in node.items:
-            name = dotted(item.context_expr)
+            expr = item.context_expr
+            # ``with tracing.locked(self.replay_lock):`` is lock
+            # acquisition with wait/hold spans around it — same mutual
+            # exclusion, so look through to the lock argument
+            if (isinstance(expr, ast.Call) and expr.args
+                    and (dotted(expr.func) or "").rsplit(".", 1)[-1]
+                    == "locked"):
+                expr = expr.args[0]
+            name = dotted(expr)
             if name and name.rsplit(".", 1)[-1] in self._lock_names:
                 canon = name.rsplit(".", 1)[-1]
                 for h in self.held:

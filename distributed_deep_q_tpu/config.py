@@ -271,6 +271,29 @@ class MeshConfig:
 
 
 @dataclass
+class TraceConfig:
+    """Distributed tracing plane (``distributed_deep_q_tpu/tracing.py``).
+
+    Off by default; when off the tracer costs a single module-flag branch
+    per instrumented site. Context piggybacks on existing wire frames as
+    ``tr_*`` keys (no wire version bump), so a traced learner and
+    untraced actors — or the reverse — interoperate freely.
+    """
+
+    enabled: bool = False
+    # fraction of per-env-step hot-path cycles that record a span
+    # (counter-based, deterministic: every round(1/rate)-th step)
+    sample_rate: float = 0.01
+    # fraction of flushes carrying per-row lineage birth stamps — the
+    # input to the learner's time_to_learn histogram
+    lineage_rate: float = 0.05
+    # per-thread span ring capacity (drop-oldest beyond this)
+    buffer_spans: int = 8192
+    # shard export directory; each process writes trace-<pid>.json here
+    dir: str = "traces"
+
+
+@dataclass
 class Config:
     net: NetConfig = field(default_factory=NetConfig)
     replay: ReplayConfig = field(default_factory=ReplayConfig)
@@ -278,6 +301,7 @@ class Config:
     env: EnvConfig = field(default_factory=EnvConfig)
     actors: ActorConfig = field(default_factory=ActorConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    trace: TraceConfig = field(default_factory=TraceConfig)
 
     def replace(self, **kv: Any) -> "Config":
         return dataclasses.replace(self, **kv)

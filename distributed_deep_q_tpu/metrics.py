@@ -168,6 +168,12 @@ class Metrics:
         """Record one observation into the named histogram."""
         self.histogram(name).observe(value)
 
+    def observe_many(self, name: str, values) -> None:
+        """Record a batch of observations (array-like) into the named
+        histogram — one bucket pass instead of N ``observe`` calls
+        (lineage ``time_to_learn`` samples arrive per training batch)."""
+        self.histogram(name).observe_many(values)
+
     def telemetry(self) -> dict[str, float]:
         """Flatten gauges + histogram summaries into scalar keys for
         ``log()``: gauges pass through by name, each histogram ``h``

@@ -30,6 +30,7 @@ import numpy as np
 import optax
 from jax import lax
 
+from distributed_deep_q_tpu import tracing
 from distributed_deep_q_tpu.compat import safe_increment, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -764,11 +765,17 @@ class Learner:
             # jax arrays (assembled by the solver) must not be copied
             return x if isinstance(x, jax.Array) else np.asarray(x, dtype)
 
-        metas, win, idx = sample(keys, rows.frames, rows.action,
-                                 rows.reward, rows.done, rows.boundary,
-                                 rows.prio, feed(cursors), feed(sizes),
-                                 feed(betas, np.float32))
-        return train(state, metas, win, idx, rows.prio, rows.maxp)
+        # spans time the host-side DISPATCH of the two async device
+        # programs, not device execution (no block_until_ready here — the
+        # zero-readback contract holds); both calls stay outside jit so
+        # the tracer's host side effects never enter a traced function
+        with tracing.span("sample"):
+            metas, win, idx = sample(keys, rows.frames, rows.action,
+                                     rows.reward, rows.done, rows.boundary,
+                                     rows.prio, feed(cursors), feed(sizes),
+                                     feed(betas, np.float32))
+        with tracing.span("train_step"):
+            return train(state, metas, win, idx, rows.prio, rows.maxp)
 
     def train_step(self, state: TrainState, batch: dict[str, Any]):
         """One synchronous DP gradient step.
@@ -780,5 +787,6 @@ class Learner:
         here. Returns (new_state, metrics dict of replicated scalars,
         |TD| [B] batch-sharded, for PER priority updates).
         """
-        return self._train_step(state, global_batch(self._batch_sharding,
-                                                    batch))
+        with tracing.span("train_step"):  # host dispatch, outside the jit
+            return self._train_step(state, global_batch(
+                self._batch_sharding, batch))

@@ -24,6 +24,8 @@ from typing import Any, Callable
 
 import jax
 
+from distributed_deep_q_tpu import tracing
+
 HOST_KEYS = ("index", "_sampled_at")
 
 
@@ -56,19 +58,24 @@ class DeviceStager:
         self._thread.start()
 
     def _stage(self, batch: dict[str, Any]) -> dict[str, Any]:
-        host = {k: batch.pop(k) for k in HOST_KEYS if k in batch}
-        if self._sharding is not None:
-            dev = jax.device_put(batch, self._sharding)
-        else:
-            dev = jax.device_put(batch)
-        dev.update(host)
-        return dev
+        with tracing.span("stage_batch"):
+            host = {k: batch.pop(k) for k in HOST_KEYS if k in batch}
+            with tracing.span("device_put"):
+                if self._sharding is not None:
+                    dev = jax.device_put(batch, self._sharding)
+                else:
+                    dev = jax.device_put(batch)
+            dev.update(host)
+            return dev
 
     def _run(self) -> None:
         try:
             while not self._stop.is_set():
-                with self._lock:
-                    batch = self._sample_fn()
+                # lock_wait (contention) and sample (work under the
+                # lock) surface as separate stages in the attribution
+                with tracing.locked(self._lock):
+                    with tracing.span("sample"):
+                        batch = self._sample_fn()
                 staged = self._stage(batch)
                 while not self._stop.is_set():
                     try:

@@ -43,6 +43,8 @@ import threading
 import time
 from dataclasses import dataclass
 
+from distributed_deep_q_tpu import tracing
+
 log = logging.getLogger(__name__)
 
 
@@ -289,6 +291,7 @@ class FlowController:
             if not self.degraded and over:
                 self.degraded = True
                 self.degraded_trips += 1
+                tracing.instant("degraded", staged=staged, rss_mb=rss)
                 log.warning("flowcontrol: DEGRADED (staged=%d/%d rss=%.0fMB"
                             "/%d) — pausing accepts, draining", staged, high,
                             rss, limit)

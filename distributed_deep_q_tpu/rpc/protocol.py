@@ -30,6 +30,15 @@ raises ``ChecksumError`` (counted as ``rpc/checksum_errors`` server-side).
 handlers (heartbeat backoff, client socket drop) classify it as a retryable
 stream fault; ``ChecksumError`` subclasses ``ProtocolError`` so the retry
 plane re-sends a corrupted frame instead of admitting it.
+
+Evolution without a version bump: NEW PLAIN DICT KEYS never need one —
+v2→v3 added credits/SHED reply fields, the telemetry spine rides
+``tm_*`` arrays on add_transitions, and the tracing plane (ISSUE 7)
+rides causal context the same way: ``tr_trace``/``tr_span``/
+``tr_sent_at`` on requests, ``tr_recv_at``/``tr_done_at`` reply stamps
+(NTP-style skew correction), and an optional ``tr_birth`` float64 array
+of per-row lineage birth times. Peers that don't know the keys ignore
+them; the canonical names live in ``tracing.KEY_*``.
 """
 
 from __future__ import annotations
@@ -40,6 +49,7 @@ from typing import Any
 
 import numpy as np
 
+from distributed_deep_q_tpu import tracing
 from distributed_deep_q_tpu.utils.durability import crc32c
 
 MAX_MESSAGE = 1 << 30  # 1 GiB sanity cap
@@ -276,6 +286,8 @@ def recv_msg(sock: socket.socket) -> dict[str, Any]:
 def recv_msg_sized(sock: socket.socket) -> tuple[dict[str, Any], int]:
     """Receive one message and its wire payload size in bytes — the size
     feeds the server's per-method payload histograms without re-encoding."""
+    # the header read is NOT spanned: a server thread blocks here waiting
+    # for the peer's next request, which is idle time, not pipeline work
     magic, version, length = _HEADER.unpack(_recv_exact(sock, HEADER_SIZE))
     if magic != MAGIC:
         raise ProtocolError(
@@ -286,12 +298,16 @@ def recv_msg_sized(sock: socket.socket) -> tuple[dict[str, Any], int]:
             f"wire version {version} (this side speaks {WIRE_VERSION})")
     if length > MAX_MESSAGE:
         raise ProtocolError(f"message of {length} bytes exceeds cap")
-    payload = _recv_exact(sock, length)
+    with tracing.span("wire_recv"):
+        payload = _recv_exact(sock, length)
+        trail = (_recv_exact(sock, TRAILER_SIZE) if version >= 4 else b"")
     if version >= 4:
-        (want,) = _TRAILER.unpack(_recv_exact(sock, TRAILER_SIZE))
-        got = crc32c(payload)
+        with tracing.span("crc_verify"):
+            (want,) = _TRAILER.unpack(trail)
+            got = crc32c(payload)
         if got != want:
             raise ChecksumError(
                 f"payload crc32c {got:08x} != trailer {want:08x} — frame "
                 "corrupted in transit")
-    return decode(payload), length
+    with tracing.span("wire_decode"):
+        return decode(payload), length

@@ -32,6 +32,7 @@ from typing import Any
 
 import numpy as np
 
+from distributed_deep_q_tpu import tracing
 from distributed_deep_q_tpu.metrics import Histogram
 from distributed_deep_q_tpu.rpc import faultinject
 from distributed_deep_q_tpu.rpc.flowcontrol import FlowConfig, FlowController
@@ -95,6 +96,11 @@ class ServerTelemetry:
         self.snapshot_bytes = 0
         self.snapshot_generations = 0
         self.snapshot_quarantined = 0
+        # tracing plane: ingest lag (actor env-step birth → server insert,
+        # ms, skew-corrected on the actor side) from lineage-stamped
+        # flushes. Covers every replay tier, including the device-resident
+        # ones whose rows have no host slot index for full time_to_learn
+        self.ingest_lag = Histogram(1e-3, 1e5)
 
     def record_dispatch_error(self) -> None:
         with self._lock:
@@ -172,6 +178,13 @@ class ServerTelemetry:
                 if h is None:
                     h = self.fleet[name] = Histogram(1e-3, 1e5)
                 h.observe_many(np.atleast_1d(samples))
+            births = req.get(tracing.KEY_BIRTH)
+            if births is not None:
+                now = tracing.now()
+                lags = (now - np.atleast_1d(births).astype(np.float64)) * 1e3
+                # a slightly-over-corrected skew can push a lag below zero;
+                # clamp to the histogram floor rather than dropping it
+                self.ingest_lag.observe_many(np.maximum(lags, 1e-3))
 
     def summary(self, params_version: int = 0) -> dict[str, float]:
         """Flat scalar view for ``Metrics.log`` / the ``stats`` RPC:
@@ -205,6 +218,9 @@ class ServerTelemetry:
             out["durability/snapshot_bytes"] = self.snapshot_bytes
             out["durability/generations"] = self.snapshot_generations
             out["durability/quarantined"] = self.snapshot_quarantined
+            if self.ingest_lag.count:  # only when a traced run fed it
+                out.update(self.ingest_lag.summary(
+                    prefix="trace/ingest_lag_ms"))
             return out
 
     def per_actor_env_steps(self) -> tuple[np.ndarray, np.ndarray]:
@@ -241,6 +257,11 @@ class ReplayFeedServer:
     # actor can fail thousands of times a second — log a sample, count all
     ERR_LOG_PERIOD = 5.0
 
+    # lineage map bound: oldest mappings evict FIFO past this — at the
+    # default lineage rate one entry rides in every ~20th transition, so
+    # this covers minutes of ingest while bounding a day-long run
+    LINEAGE_CAP = 16384
+
     def __init__(self, replay, host: str = "127.0.0.1", port: int = 0,
                  snapshot_path: str = "", flow: FlowConfig | None = None,
                  snapshot_keep: int = 3):
@@ -274,6 +295,12 @@ class ReplayFeedServer:
         # Guarded by replay_lock — the seq check and the insert must be one
         # atomic step or an ambiguous retry could still double-insert.
         self._flush_seq: dict[int, int] = {}
+        # transition lineage: ring slot → (birth stamp, env_steps at
+        # insert) for lineage-sampled rows. Guarded by replay_lock (the
+        # slot index is only meaningful against the ring state it was
+        # written under). Bounded FIFO — a sampled diagnostic, not a
+        # ledger; see LINEAGE_CAP
+        self._lineage: dict[int, tuple[float, int]] = {}
         self._err_log_at = 0.0
         self._err_suppressed = 0
         # live accepted connections, closed on shutdown so reconnecting
@@ -395,7 +422,7 @@ class ReplayFeedServer:
         from distributed_deep_q_tpu.replay.persistence import replay_state
 
         t0 = time.perf_counter()
-        with self.replay_lock:
+        with tracing.span("snapshot_capture"), self.replay_lock:
             with self._params_lock:
                 wire = self._params_wire
                 version = self._params_version
@@ -427,12 +454,14 @@ class ReplayFeedServer:
         (async)."""
         state, rstate, version, capture_ms = cap
         t0 = time.perf_counter()
-        files = {"server.npz": savez_bytes(**state)}
-        if rstate is not None:
-            files["replay.npz"] = savez_bytes(**rstate)
-        store = GenerationStore(path, keep=self.snapshot_keep)
-        gen = store.commit(files, meta={"params_version": version,
-                                        "env_steps": int(state["env_steps"])})
+        with tracing.span("snapshot_write"):
+            files = {"server.npz": savez_bytes(**state)}
+            if rstate is not None:
+                files["replay.npz"] = savez_bytes(**rstate)
+            store = GenerationStore(path, keep=self.snapshot_keep)
+            gen = store.commit(
+                files, meta={"params_version": version,
+                             "env_steps": int(state["env_steps"])})
         nbytes = sum(len(b) for b in files.values())
         self.telemetry.record_snapshot(
             capture_ms, 1e3 * (time.perf_counter() - t0), nbytes,
@@ -494,6 +523,7 @@ class ReplayFeedServer:
         self.episodes = 0
         self.returns.clear()
         self._flush_seq = {}
+        self._lineage = {}
         self._params_version = 0
         self._params_wire = None
 
@@ -529,7 +559,8 @@ class ReplayFeedServer:
                 break
             gen, files, _meta = pick
             try:
-                self._load_generation(files)
+                with tracing.span("restore"):
+                    self._load_generation(files)
             except Exception as e:  # noqa: BLE001 — any load failure
                 # must fall back, not kill the boot
                 self._reset_boot_state()
@@ -556,7 +587,8 @@ class ReplayFeedServer:
         if os.path.exists(replay_file):
             files["replay.npz"] = replay_file
         try:
-            self._load_generation(files)
+            with tracing.span("restore"):
+                self._load_generation(files)
         except Exception as e:  # noqa: BLE001 — truncated/corrupt legacy
             # npz (torn write by an old build) must not crash the boot
             self._reset_boot_state()
@@ -674,75 +706,10 @@ class ReplayFeedServer:
             self.last_seen[actor_id] = time.monotonic()
 
         if method == "add_transitions":
-            # row count up front: the admission controller needs it before
-            # any insert happens (sequence batches carry explicit env_steps;
-            # overlapping windows would double-count otherwise)
-            if "init_c" in req:
-                n = int(req.get("env_steps", len(req["action"])))
-            else:
-                n = len(req["action"])
-            with self.replay_lock:
-                # idempotent-flush dedup: a resilient client resends a
-                # failed flush with the SAME flush_seq; if the first send
-                # actually landed (ack lost — the ambiguous failure), the
-                # stamp is already recorded and the retry must be a no-op
-                # or replay would hold duplicated transitions. Dedup wins
-                # over admission: the data is already in, shedding the
-                # retry would only make the client resend a third time
-                seq = int(req.get("flush_seq", -1))
-                if seq >= 0 and actor_id >= 0 \
-                        and seq <= self._flush_seq.get(actor_id, -1):
-                    self.telemetry.record_duplicate_flush()
-                    return {"ok": True, "duplicate": True,
-                            "env_steps": self.env_steps,
-                            "credits": self.flow.grant(actor_id),
-                            "params_version": self._published_version()}
-                admitted, retry_ms = self.flow.admit(actor_id, n)
-                if not admitted:
-                    # explicit SHED — never a silent drop. The seq stays
-                    # unstamped, so the client re-sends the SAME flush
-                    # after retry_after_ms and it lands exactly once when
-                    # the backlog clears (PR 2 zero-loss contract holds)
-                    self.telemetry.record_shed(actor_id)
-                    return {"ok": False, "shed": True,
-                            "retry_after_ms": retry_ms,
-                            "credits": self.flow.grant(actor_id),
-                            "params_version": self._published_version()}
-                if "init_c" in req:  # R2D2 sequence batch → SequenceReplay
-                    self.replay.add_batch(
-                        {k: req[k] for k in
-                         ("obs", "action", "reward", "discount", "mask",
-                          "init_c", "init_h")})
-                elif "frame" in req:  # pixel stream → frame/device ring
-                    batch = {k: req[k] for k in
-                             ("frame", "action", "reward", "done", "boundary")
-                             if k in req}
-                    if _takes_stream(self.replay):
-                        self.replay.add_batch(batch, stream=actor_id)
-                    else:
-                        self.replay.add_batch(batch)
-                else:  # explicit n-step transitions (vector envs)
-                    self.replay.add_batch(
-                        {k: req[k] for k in
-                         ("obs", "action", "reward", "next_obs", "discount")})
-                self.env_steps += n
-                self.episodes += int(req.get("episodes", 0))
-                for r in np.atleast_1d(req.get("ep_returns",
-                                               np.zeros(0, np.float32))):
-                    self.returns.append(float(r))
-                # stamp AFTER the insert succeeded: a failed insert must
-                # leave the seq unclaimed (the client is told via the
-                # error dict; only a clean landing may absorb its retries)
-                if seq >= 0 and actor_id >= 0:
-                    self._flush_seq[actor_id] = seq
-                self.flow.on_ingest(actor_id, n)
-                credits = self.flow.grant(actor_id)
-                total = self.env_steps
-            self.telemetry.on_transitions(actor_id, n, req)
-            # credits + published θ version ride every reply: the client's
-            # token bucket and staleness guard get their inputs for free
-            return {"ok": True, "env_steps": total, "credits": credits,
-                    "params_version": self._published_version()}
+            # adopt the actor's causal context (tr_* keys on the frame, if
+            # any) so the server-side spans hang off the client's rpc_call
+            with tracing.activate(req):
+                return self._add_transitions(req, actor_id)
 
         if method == "get_params":
             with self._params_lock:
@@ -793,6 +760,145 @@ class ReplayFeedServer:
 
         return {"error": f"unknown method {method!r}"}
 
+    def _add_transitions(self, req: dict[str, Any],
+                         actor_id: int) -> dict[str, Any]:
+        # NTP recv stamp (server clock): paired with the done stamp below,
+        # it gives the client a skew sample on every traced flush reply
+        t2 = tracing.now() if (tracing.ENABLED
+                               and tracing.KEY_SENT_AT in req) else 0.0
+        # row count up front: the admission controller needs it before
+        # any insert happens (sequence batches carry explicit env_steps;
+        # overlapping windows would double-count otherwise)
+        if "init_c" in req:
+            n = int(req.get("env_steps", len(req["action"])))
+        else:
+            n = len(req["action"])
+        with tracing.locked(self.replay_lock):
+            # idempotent-flush dedup: a resilient client resends a
+            # failed flush with the SAME flush_seq; if the first send
+            # actually landed (ack lost — the ambiguous failure), the
+            # stamp is already recorded and the retry must be a no-op
+            # or replay would hold duplicated transitions. Dedup wins
+            # over admission: the data is already in, shedding the
+            # retry would only make the client resend a third time
+            seq = int(req.get("flush_seq", -1))
+            if seq >= 0 and actor_id >= 0 \
+                    and seq <= self._flush_seq.get(actor_id, -1):
+                self.telemetry.record_duplicate_flush()
+                return {"ok": True, "duplicate": True,
+                        "env_steps": self.env_steps,
+                        "credits": self.flow.grant(actor_id),
+                        "params_version": self._published_version(),
+                        **self._reply_stamps(t2)}
+            admitted, retry_ms = self.flow.admit(actor_id, n)
+            if not admitted:
+                # explicit SHED — never a silent drop. The seq stays
+                # unstamped, so the client re-sends the SAME flush
+                # after retry_after_ms and it lands exactly once when
+                # the backlog clears (PR 2 zero-loss contract holds)
+                self.telemetry.record_shed(actor_id)
+                return {"ok": False, "shed": True,
+                        "retry_after_ms": retry_ms,
+                        "credits": self.flow.grant(actor_id),
+                        "params_version": self._published_version(),
+                        **self._reply_stamps(t2)}
+            if "init_c" in req:  # R2D2 sequence batch → SequenceReplay
+                with tracing.span("ring_insert"):
+                    idx = self.replay.add_batch(
+                        {k: req[k] for k in
+                         ("obs", "action", "reward", "discount", "mask",
+                          "init_c", "init_h")})
+            elif "frame" in req:  # pixel stream → frame/device ring
+                batch = {k: req[k] for k in
+                         ("frame", "action", "reward", "done", "boundary")
+                         if k in req}
+                with tracing.span("ring_insert"):
+                    if _takes_stream(self.replay):
+                        idx = self.replay.add_batch(batch, stream=actor_id)
+                    else:
+                        idx = self.replay.add_batch(batch)
+            else:  # explicit n-step transitions (vector envs)
+                with tracing.span("ring_insert"):
+                    idx = self.replay.add_batch(
+                        {k: req[k] for k in
+                         ("obs", "action", "reward", "next_obs",
+                          "discount")})
+            self.env_steps += n
+            self.episodes += int(req.get("episodes", 0))
+            for r in np.atleast_1d(req.get("ep_returns",
+                                           np.zeros(0, np.float32))):
+                self.returns.append(float(r))
+            # stamp AFTER the insert succeeded: a failed insert must
+            # leave the seq unclaimed (the client is told via the
+            # error dict; only a clean landing may absorb its retries)
+            if seq >= 0 and actor_id >= 0:
+                self._flush_seq[actor_id] = seq
+            self._record_lineage(req, idx)
+            self.flow.on_ingest(actor_id, n)
+            credits = self.flow.grant(actor_id)
+            total = self.env_steps
+        self.telemetry.on_transitions(actor_id, n, req)
+        # credits + published θ version ride every reply: the client's
+        # token bucket and staleness guard get their inputs for free
+        return {"ok": True, "env_steps": total, "credits": credits,
+                "params_version": self._published_version(),
+                **self._reply_stamps(t2)}
+
+    @staticmethod
+    def _reply_stamps(t2: float) -> dict[str, float]:
+        """NTP reply stamps (server recv / reply built, server clock) for
+        the client's skew estimator. Empty unless the request carried a
+        send stamp — untraced peers get byte-identical replies."""
+        if not t2:
+            return {}
+        return {tracing.KEY_RECV_AT: t2, tracing.KEY_DONE_AT: tracing.now()}
+
+    def _record_lineage(self, req: dict[str, Any], idx) -> None:
+        """Map written ring slots → (birth stamp, env_steps at insert) for
+        the learner's ``time_to_learn`` lookup. Caller holds
+        ``replay_lock``. Only host replay tiers return slot indices from
+        ``add_batch``; device/fused tiers fall back to the flush-level
+        ``trace/ingest_lag_ms`` histogram in ``ServerTelemetry``."""
+        births = req.get(tracing.KEY_BIRTH)
+        if births is None or not isinstance(idx, np.ndarray):
+            return
+        births = np.atleast_1d(births).astype(np.float64)
+        slots = np.ravel(idx)
+        if slots.size != births.size:
+            # sequence batches write slots ≠ rows (overlapping windows);
+            # a row→slot pairing would be wrong, so those tiers keep the
+            # flush-level ingest-lag histogram only
+            return
+        pos = self.env_steps  # ddq: allow(locks.unguarded) — caller holds
+        for slot, birth in zip(slots, births):
+            self._lineage[int(slot)] = (float(birth), pos)  # ddq: allow(locks.unguarded)
+        while len(self._lineage) > self.LINEAGE_CAP:  # ddq: allow(locks.unguarded)
+            self._lineage.pop(next(iter(self._lineage)))  # ddq: allow(locks.unguarded)
+
+    def lineage_ages(self, indices) -> np.ndarray:
+        """Ages (seconds, server clock) of the lineage-stamped rows among
+        the sampled ring slots ``indices`` — env-step birth to now, i.e.
+        ``time_to_learn`` when called at gradient consumption. A mapping
+        whose slot the ring has since wrapped past is dropped (that slot
+        now holds a younger row than the stamp describes)."""
+        if not tracing.ENABLED:
+            return np.zeros(0, np.float64)
+        now = tracing.now()
+        ages = []
+        with self.replay_lock:
+            cap = int(getattr(self.replay, "capacity", 0) or 0)
+            steps = self.env_steps
+            for slot in np.ravel(np.asarray(indices)):
+                ent = self._lineage.get(int(slot))
+                if ent is None:
+                    continue
+                birth, pos = ent
+                if cap and steps - pos >= cap:
+                    self._lineage.pop(int(slot), None)
+                    continue
+                ages.append(max(now - birth, 0.0))
+        return np.asarray(ages, np.float64)
+
     # -- telemetry ----------------------------------------------------------
 
     def telemetry_summary(self) -> dict[str, float]:
@@ -817,6 +923,8 @@ class ReplayFeedServer:
         out["flow/shed_total"] = fc["shed_total"]
         out["flow/consume_rate"] = round(fc["consume_rate"], 3)
         out["flow/ingest_rate"] = round(fc["ingest_rate"], 3)
+        if tracing.ENABLED:  # span-buffer/drop + clock-skew gauges
+            out.update(tracing.counters())
         return out
 
 
@@ -855,6 +963,7 @@ class ReplayFeedClient:
     def call(self, method: str, **kwargs: Any) -> dict[str, Any]:
         with self._lock:
             if self._sock is None:
+                tracing.instant("reconnect", method=method)
                 self._connect()
             try:
                 send_msg(self._sock, {"method": method,

@@ -44,6 +44,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from distributed_deep_q_tpu import tracing
 from distributed_deep_q_tpu.rpc.flowcontrol import TokenBucket
 from distributed_deep_q_tpu.rpc.protocol import ProtocolError
 
@@ -159,6 +160,7 @@ class ResilientReplayFeedClient:
     def _on_retry(self, method: str) -> Callable[[int, BaseException], None]:
         def cb(attempt: int, e: BaseException) -> None:
             self.retries += 1
+            tracing.instant("retry", method=method, attempt=attempt)
             if attempt == 0:  # one line per outage, not per attempt
                 log.info("rpc %s failed (%s: %s); retrying with backoff",
                          method, type(e).__name__, e)
@@ -195,31 +197,56 @@ class ResilientReplayFeedClient:
         the socket nor burns the retry deadline."""
         rows = int(batch.get("env_steps", 0)) or \
             len(batch.get("action", ())) or 1
-        wait = self.bucket.reserve(rows)
-        if wait > 0.0:
-            self.throttled_s += wait
-            self._sleep_backpressure(wait)
-        self._flush_seq += 1
-        seq = self._flush_seq
-        while True:
-            resp = self._run(
-                "add_transitions",
-                lambda: self._client.call("add_transitions",
-                                          flush_seq=seq, **batch))
-            if resp.get("error"):
-                # the server rejected the payload (malformed batch, not a
-                # transport fault) — surface it loudly; retrying cannot help
-                raise RPCError(f"add_transitions rejected: {resp['error']}")
-            self._note_reply(resp)
-            if resp.get("shed"):
-                self.sheds += 1
-                delay = max(float(resp.get("retry_after_ms", 100)), 10.0) \
-                    / 1e3
-                # decorrelate the fleet's re-sends a little
-                delay *= 1.0 + 0.25 * float(self._rng.random())
-                self._sleep_backpressure(delay)
-                continue
-            return resp
+        with tracing.span("flush"):
+            wait = self.bucket.reserve(rows)
+            if wait > 0.0:
+                self.throttled_s += wait
+                with tracing.span("bucket_wait"):
+                    self._sleep_backpressure(wait)
+            self._flush_seq += 1
+            seq = self._flush_seq
+            while True:
+                # causal context + send stamp ride the frame as plain
+                # tr_* keys (tm_* piggyback precedent — no version bump);
+                # empty when tracing is off, so untraced peers see the
+                # exact pre-ISSUE-7 payload
+                ctx = tracing.wire_context()
+                t1 = tracing.now() if tracing.ENABLED else 0.0
+                with tracing.span("rpc_call"):
+                    resp = self._run(
+                        "add_transitions",
+                        lambda: self._client.call("add_transitions",
+                                                  flush_seq=seq, **ctx,
+                                                  **batch))
+                if resp.get("error"):
+                    # the server rejected the payload (malformed batch,
+                    # not a transport fault) — surface it loudly;
+                    # retrying cannot help
+                    raise RPCError(
+                        f"add_transitions rejected: {resp['error']}")
+                self._note_reply(resp)
+                if tracing.ENABLED:
+                    # NTP-style skew sample: our t1/t4 + the server's
+                    # recv/reply stamps → offset to the server clock
+                    # (corrects lineage birth stamps + aligns shards)
+                    t2 = resp.get(tracing.KEY_RECV_AT)
+                    t3 = resp.get(tracing.KEY_DONE_AT)
+                    if t2 is not None and t3 is not None:
+                        off, rtt = tracing.estimate_skew(
+                            t1, float(t2), float(t3), tracing.now())
+                        tracing.record_skew(off, rtt)
+                if resp.get("shed"):
+                    self.sheds += 1
+                    tracing.instant(
+                        "shed",
+                        retry_after_ms=float(resp.get("retry_after_ms", 0)))
+                    delay = max(float(resp.get("retry_after_ms", 100)),
+                                10.0) / 1e3
+                    # decorrelate the fleet's re-sends a little
+                    delay *= 1.0 + 0.25 * float(self._rng.random())
+                    self._sleep_backpressure(delay)
+                    continue
+                return resp
 
     def _note_reply(self, resp: dict[str, Any]) -> None:
         credits = resp.get("credits")

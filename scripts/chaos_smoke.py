@@ -9,7 +9,10 @@ Four modes:
     learner is killed and warm-rebooted from its snapshot mid-run on the
     same port. Prints one JSON verdict line; exit status 1 if any
     transition was lost or duplicated. Fast (seconds), CPU-only, no jax —
-    runnable on any box as a release gate for the resilience plane.
+    runnable on any box as a release gate for the resilience plane. The
+    run is traced end to end (sample_rate=1), and the verdict also gates
+    on causal integrity: zero orphan spans, retry cycles visible as
+    ``retry`` instants (overload mode: sheds visible as ``shed``).
 
 ``python scripts/chaos_smoke.py overload [spec]``
     Overload acceptance (ISSUE 5): a producer fleet deliberately outruns a
@@ -57,6 +60,38 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def _trace_begin():
+    """Turn the tracer fully on for a chaos run (every span, no
+    sampling): the run doubles as the causal-integrity acceptance —
+    faults must not orphan spans or lose SHED/retry events."""
+    from distributed_deep_q_tpu import tracing
+
+    tracing.reset()
+    tracing.configure(enabled=True, sample_rate=1.0, lineage_rate=1.0,
+                      buffer_spans=1 << 17)
+    return tracing
+
+
+def _trace_verdict(tracing) -> dict:
+    """Drain the traced run and check causal integrity. An orphan is an
+    event whose ``parent`` span id was never recorded — under chaos that
+    would mean a dropped/torn context, so the count gates ``ok``."""
+    events = tracing.drain()
+    dropped = tracing.drop_count()
+    tracing.disable()
+    ids = {e["args"]["span"] for e in events if e.get("ph") == "X"}
+    ids.add(0)
+    orphans = [e for e in events if e["args"].get("parent", 0) not in ids]
+    instants: dict[str, int] = {}
+    for e in events:
+        if e.get("ph") == "i":
+            instants[e["name"]] = instants.get(e["name"], 0) + 1
+    return {"spans": sum(1 for e in events if e.get("ph") == "X"),
+            "orphan_spans": len(orphans),
+            "span_drops": dropped,
+            "instants": instants}
+
+
 def run_chaos_smoke(num_actors: int = 4, flushes: int = 120, rows: int = 8,
                     spec: str = "drop=0.03,truncate=0.02,seed=11",
                     deadline: float = 120.0) -> dict:
@@ -66,6 +101,7 @@ def run_chaos_smoke(num_actors: int = 4, flushes: int = 120, rows: int = 8,
     from distributed_deep_q_tpu.rpc.resilience import (
         ResilientReplayFeedClient, RetryPolicy)
 
+    trc = _trace_begin()
     plan = faultinject.install(spec)
     snap = tempfile.mktemp(prefix="chaos_smoke_")
     total = num_actors * flushes * rows
@@ -139,6 +175,13 @@ def run_chaos_smoke(num_actors: int = 4, flushes: int = 120, rows: int = 8,
     }
     server.close()
     faultinject.uninstall()
+    trace = _trace_verdict(trc)
+    verdict["trace"] = trace
+    # causal integrity under drop/truncate chaos: no orphaned spans, and
+    # every client retry cycle left a visible "retry" instant
+    verdict["ok"] = (verdict["ok"] and trace["orphan_spans"] == 0
+                     and (sum(retries) == 0
+                          or trace["instants"].get("retry", 0) > 0))
     return verdict
 
 
@@ -156,6 +199,7 @@ def run_overload_smoke(num_actors: int = 3, flushes: int = 40, rows: int = 16,
     from distributed_deep_q_tpu.rpc.resilience import (
         ResilientReplayFeedClient, RetryPolicy)
 
+    trc = _trace_begin()
     plan = faultinject.install(spec) if spec else None
     total = num_actors * flushes * rows
     replay = ReplayMemory(max(2 * total, 1024), (2,), np.float32, seed=0)
@@ -249,6 +293,13 @@ def run_overload_smoke(num_actors: int = 3, flushes: int = 40, rows: int = 16,
     }
     server.close()
     faultinject.uninstall()
+    trace = _trace_verdict(trc)
+    verdict["trace"] = trace
+    # sheds are cooperation, not loss — and they must be VISIBLE: every
+    # client shed/re-stage cycle leaves a distinct "shed" instant
+    verdict["ok"] = (verdict["ok"] and trace["orphan_spans"] == 0
+                     and (client_sheds == 0
+                          or trace["instants"].get("shed", 0) > 0))
     return verdict
 
 

@@ -14,8 +14,12 @@ Reads the JSONL a ``Metrics(jsonl_path=...)`` run wrote and prints:
   the actors flushed back (``fleet/*``);
 - queue gauges — replay/staged-row depths and params-version lag
   (``queue/*``), the r5 host-OOM early-warning signals;
+- tracing & data age — span-drop / clock-skew counters (``trace/*``)
+  and the ingest-lag histogram; ``learner/time_to_learn_ms`` rides the
+  learner table. Runs that never enabled tracing emit none of these
+  keys and the sections simply don't print;
 - anomalies — bad JSON, non-monotonic steps, logging gaps, stalled
-  counters, non-finite values.
+  counters, non-finite values, span-ring overflow.
 
 Pure stdlib (json/math/argparse): usable on any host with the JSONL file,
 no jax/numpy required. ``load_records`` / ``validate_records`` are
@@ -248,7 +252,31 @@ def render_report(records: list[dict], last: int = 0) -> str:
     _table("durability (snapshots & integrity)", rows,
            ("gauge", "last", "min", "max"), out)
 
+    # tracing plane: tracer counters + flush-level data-age histogram.
+    # A run that never enabled tracing logs none of these keys, so both
+    # row lists stay empty and _table skips the sections cleanly.
+    rows = []
+    for key in ("trace/spans_dropped", "trace/spans_buffered",
+                "trace/clock_skew_ms", "trace/skew_samples"):
+        vals = [v for v in _series(records, key)
+                if isinstance(v, (int, float))]
+        if vals:
+            rows.append((key, vals[-1], min(vals), max(vals)))
+    _table("tracing (spans & clock skew)", rows,
+           ("gauge", "last", "min", "max"), out)
+    rows = [(name[6:], d.get("count"), d.get("p50"), d.get("p95"),
+             d.get("p99"), d.get("max"))
+            for name, d in sorted(_hist_groups(records, "trace/").items())]
+    _table("data age (ms)", rows,
+           ("histogram", "count", "p50", "p95", "p99", "max"), out)
+
     problems = validate_records(records) + _gap_anomalies(records)
+    drops = [v for v in _series(records, "trace/spans_dropped")
+             if isinstance(v, (int, float))]
+    if drops and drops[-1] > 0:
+        problems.append(
+            f"tracing: {int(drops[-1])} spans dropped (ring overflow) — "
+            "raise trace.buffer_spans or lower trace.sample_rate")
     out.append(f"\n== anomalies ({len(problems)}) ==")
     for p in problems[:50]:
         out.append(f"  ! {p}")

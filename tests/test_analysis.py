@@ -13,7 +13,7 @@ import pytest
 
 from distributed_deep_q_tpu.analysis import repo_root, run_all
 from distributed_deep_q_tpu.analysis import (
-    atomic_writes, config_keys, locks, protocol_drift, purity)
+    atomic_writes, config_keys, locks, metric_keys, protocol_drift, purity)
 from distributed_deep_q_tpu.analysis.core import Source
 
 
@@ -338,7 +338,8 @@ def test_config_schema_parsed_from_real_config():
         os.path.join(repo_root(), config_keys.CONFIG_FILE),
         config_keys.CONFIG_FILE)
     schema = config_keys.config_schema(cfg_src)
-    assert set(schema) == {"net", "replay", "train", "env", "actors", "mesh"}
+    assert set(schema) == {"net", "replay", "train", "env", "actors",
+                           "mesh", "trace"}
     assert "num_actions" in schema["net"]
     assert "server_snapshot_path" in schema["train"]
 
@@ -441,6 +442,62 @@ def test_unsuppressed_finding_still_fails():
                 return self.count  # ddq: allow(purity.print)
     """)], LOCK_REG)
     assert rules(findings) == {locks.RULE_UNGUARDED}
+
+
+# ---------------------------------------------------------------------------
+# metric keys
+# ---------------------------------------------------------------------------
+
+
+def _tracing_src() -> Source:
+    return Source.load(os.path.join(
+        repo_root(), "distributed_deep_q_tpu", "tracing.py"))
+
+
+def test_metric_keys_typo_caught():
+    findings = metric_keys.check_sources([src("""
+        metrics.gauge("queue/replay_sise", 1)
+        self.metrics.count("grad_stepz")
+    """)], _tracing_src())
+    assert [f.rule for f in findings] == [metric_keys.RULE_METRIC] * 2
+
+
+def test_metric_keys_known_and_dynamic_names_clean():
+    findings = metric_keys.check_sources([src("""
+        metrics.gauge("queue/replay_size", 1)
+        metrics.count("grad_steps")
+        out[f"rpc/{m}_calls"] = 1            # dynamic: out of static reach
+        h.summary(prefix="trace/ingest_lag_ms")
+    """)], _tracing_src())
+    assert findings == []
+
+
+def test_metric_keys_span_names_checked_against_tracer_tables():
+    findings = metric_keys.check_sources([src("""
+        from distributed_deep_q_tpu import tracing
+        with tracing.span("env_step"):
+            tracing.instant("shed")
+        with tracing.span("env_stepp"):
+            tracing.instant("shedd")
+    """)], _tracing_src())
+    assert [f.rule for f in findings] == [metric_keys.RULE_SPAN] * 2
+    assert all("tracing." in f.message for f in findings)
+
+
+def test_metric_keys_pragma_suppresses():
+    findings = metric_keys.check_sources([src("""
+        metrics.gauge("queue/oops", 1)  # ddq: allow(metric_keys.unknown-metric)
+    """)], _tracing_src())
+    assert findings == []
+
+
+def test_metric_keys_gate_fails_on_seeded_typo():
+    """Un-declaring a really-emitted name makes the REAL tree fail —
+    i.e. a typo'd emit site (name not in the registry) fails the gate."""
+    culled = frozenset(metric_keys.REGISTRY - {"queue/replay_size"})
+    findings = metric_keys.check(repo_root(), registry=culled)
+    assert any(f.rule == metric_keys.RULE_METRIC
+               and "queue/replay_size" in f.message for f in findings)
 
 
 # ---------------------------------------------------------------------------
