@@ -704,6 +704,7 @@ def trace_ingest(cfg_mod, on_cpu: bool) -> None:
                            device_per=True, num_streams=writers,
                            prefill=4_096 if on_cpu else 20_000)
     lock = threading.Lock()
+    replay.start_drain(lock)  # production ingest shape: drained, not inline
 
     def one_step():
         # the inner sample/train_step spans come from the learner's
@@ -735,6 +736,7 @@ def trace_ingest(cfg_mod, on_cpu: bool) -> None:
     stop.set()
     for th in threads:
         th.join(timeout=10.0)
+    replay.stop_drain()
 
     path = tracing.export()  # drains the rings into the Perfetto shard
     dropped = tracing.drop_count()
@@ -942,6 +944,9 @@ def main() -> None:
     for target in ((INGEST_TARGET,) if on_cpu else (256, INGEST_TARGET,
                                                     4096)):
         lock = threading.Lock()
+        # batched staging→device drain (ISSUE 8): writers stage + notify;
+        # the drain thread owns the flush dispatch under the shared lock
+        replay.start_drain(lock)
         stop = threading.Event()
         counter = [0] * writers
         window = {}
@@ -969,6 +974,7 @@ def main() -> None:
         # target's lock while the next target measures under a fresh one
         for th in window.get("threads", ()):
             th.join(timeout=10.0)
+        replay.stop_drain()  # next target re-attaches under a fresh lock
         under = float(np.median(irates))
         curve[str(target)] = {
             "steps_per_s": round(under, 2),
@@ -998,11 +1004,15 @@ def main() -> None:
     # moving <10% the same sessions). They are annotated rather than
     # silently noisy; cross-round comparisons should use the chained
     # keys and in_scan_step_ms.
+    # ingest_curve graduated OUT of the tunnel-bound set (ISSUE 8): with
+    # the columnar stage + batched drain the curve's steps_per_s track
+    # the chained learner (spread recorded per point), so bench_diff
+    # gates them like any other row instead of annotate-only.
     out["tunnel_bound_keys"] = [
         "idle_uniform_steps_per_s", "pallas_on_steps_per_s",
         "pallas_off_steps_per_s", "batch32_single_dispatch_steps_per_s",
         "r2d2_host_steps_per_s", "r2d2_device_steps_per_s",
-        "flagship_under_ingest_steps_per_s", "ingest_curve"]
+        "flagship_under_ingest_steps_per_s"]
     dev = jax.devices()[0]
     peak = peak_flops_for(dev)
     out["device_kind"] = getattr(dev, "device_kind", dev.platform)

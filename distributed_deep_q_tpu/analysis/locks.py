@@ -143,6 +143,16 @@ DEFAULT_REGISTRY = LockRegistry(
         "degraded":         Guard("replay_lock", "FlowController"),
         "degraded_trips":   Guard("replay_lock", "FlowController"),
         "shed_total":       Guard("replay_lock", "FlowController"),
+        # IngestDrain (columnar ingest plane, ISSUE 8): the drain
+        # thread's stop flag, throughput counters, and recorded death
+        # move under its condition variable. The staging buffers
+        # themselves (ColumnStage) carry no lock — they are serialized
+        # by the caller's replay lock, which the drain re-acquires for
+        # every flush (same mutual exclusion as the inline path)
+        "_stop":            Guard("_cv", "IngestDrain"),
+        "_drained_rows":    Guard("_cv", "IngestDrain"),
+        "_drain_flushes":   Guard("_cv", "IngestDrain"),
+        "_err":             Guard("_cv", "IngestDrain"),
         # NOTE deliberately unregistered: ReplayFeedServer.last_seen is a
         # GIL-atomic monotonic stamp dict (single-writer per key, reader
         # tolerates staleness); DeviceStager._err is benign once-set.
@@ -155,6 +165,7 @@ DEFAULT_REGISTRY = LockRegistry(
         "distributed_deep_q_tpu/rpc/replay_server.py",
         "distributed_deep_q_tpu/actors/supervisor.py",
         "distributed_deep_q_tpu/replay/staging.py",
+        "distributed_deep_q_tpu/replay/columnar.py",
         "distributed_deep_q_tpu/native/__init__.py",
     ),
 )

@@ -41,7 +41,7 @@ RULE_METRIC = "metric_keys.unknown-metric"
 RULE_SPAN = "metric_keys.unknown-span"
 
 NAMESPACES = ("rpc", "fleet", "queue", "durability", "flow", "trace",
-              "learner")
+              "learner", "ingest")
 _NS_RE = re.compile(r"^(?:%s)/.+" % "|".join(NAMESPACES))
 
 EMITTERS = frozenset(
@@ -95,6 +95,9 @@ REGISTRY = frozenset({
     "trace/spans_dropped",
     "learner/publish_params_ms",
     "learner/time_to_learn_ms",
+    # columnar ingest plane (ISSUE 8): drain-thread throughput gauges
+    "ingest/drained_rows",
+    "ingest/drain_flushes",
 })
 
 _TRACING_REL = os.path.join("distributed_deep_q_tpu", "tracing.py")

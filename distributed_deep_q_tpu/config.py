@@ -75,6 +75,22 @@ class ReplayConfig:
     sequence_length: int = 80
     burn_in: int = 40
     use_native: bool = True  # use the C++ replay core when available
+    # columnar ingest staging (ISSUE 8): staged rows land in per-shard
+    # per-column preallocated buffers (one memcpy per column per staged
+    # segment — replay/columnar.py) instead of the legacy per-flush FIFO
+    # of array tuples. False selects the legacy reference path, kept
+    # bit-identical for the staged≡legacy equivalence tests
+    staging_columnar: bool = True
+    # initial per-shard staging-buffer depth in rows (grows by doubling;
+    # occupancy is bounded in practice by staged_high_watermark)
+    staging_depth: int = 4096
+    # background staging→device drain thread (replay.start_drain): the
+    # server/bench attach it so writers never pay the device dispatch.
+    # Ignored on multi-host meshes (flushes are lockstep collectives)
+    ingest_drain: bool = True
+    # rows staged before the drain thread dispatches a batched flush
+    # (0 = write_chunk)
+    drain_min_rows: int = 0
     # optional replay persistence (SURVEY §5.4): when set, the buffer's
     # complete sampling state (rings, cursors, trees, RNG) is dumped to
     # this .npz alongside learner checkpoints and restored on

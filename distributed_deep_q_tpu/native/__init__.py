@@ -29,6 +29,8 @@ _tried = False
 
 _c_double_p = ctypes.POINTER(ctypes.c_double)
 _c_int64_p = ctypes.POINTER(ctypes.c_int64)
+_c_u8p = ctypes.POINTER(ctypes.c_uint8)
+_c_u8pp = ctypes.POINTER(_c_u8p)
 
 
 def _build() -> bool:
@@ -79,6 +81,10 @@ def load() -> ctypes.CDLL | None:
             _c_double_p, ctypes.c_int64, _c_double_p, _c_int64_p,
             ctypes.c_int64]
         lib.st_sample_stratified.restype = None
+        lib.staged_append.argtypes = [
+            _c_u8pp, _c_u8pp, _c_int64_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64]
+        lib.staged_append.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -89,3 +95,14 @@ def as_double_p(a) -> _c_double_p:
 
 def as_int64_p(a) -> _c_int64_p:
     return a.ctypes.data_as(_c_int64_p)
+
+
+def as_uint8_p(a) -> _c_u8p:
+    return a.ctypes.data_as(_c_u8p)
+
+
+def uint8_pp(ptrs) -> _c_u8pp:
+    """Pack an iterable of c_uint8 pointers into the pointer-array
+    argument ``staged_append`` takes for its dst/src column tables."""
+    arr = (_c_u8p * len(ptrs))(*ptrs)
+    return ctypes.cast(arr, _c_u8pp)

@@ -1,0 +1,183 @@
+"""Zero-copy columnar ingest staging + batched host→device drain.
+
+ISSUE 8 tentpole. BENCH_r05 measured `ingest_transitions_per_s` at ~667
+against a flagship sampler that wants ~300k rows/s — the ceiling was
+per-flush Python, twice over: every staged segment allocated a fresh
+dict-of-arrays tuple (O(segments) object churn on the `replay_lock` hot
+path), and every chunk-boundary flush dispatched its device transfer
+from whichever WRITER thread happened to cross the boundary, holding
+the lock across the dispatch.
+
+Two pieces replace that:
+
+- ``ColumnStage`` — per-shard, per-column preallocated staging buffers.
+  Decoded flush payloads land with ONE memcpy per column
+  (``native/replay_core.cpp::staged_append``; the numpy slice-assign
+  fallback is the bit-identical reference), and the flush drains
+  contiguous column slices instead of walking a FIFO of tuples. Not
+  thread-safe by itself: callers serialize appends and takes under the
+  replay lock, exactly like the ``_pending`` FIFO it replaces.
+- ``IngestDrain`` — a background transfer thread that batches staged
+  columns into the device ring (`replay.flush()` under the shared
+  lock) whenever a full write chunk is pending, so writer threads pay
+  a cursor bump + condition notify and never the device dispatch.
+
+The drain shares the caller's replay lock (same mutual exclusion as the
+old inline flush — ``analysis/locks.py`` walks this file); its own
+bookkeeping lives under ``_cv``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from distributed_deep_q_tpu import native, tracing
+
+
+class ColumnStage:
+    """Preallocated columnar staging for one replay shard.
+
+    ``columns`` is a list of ``(tail_shape, dtype)`` — column 0 is the
+    in-shard row index, the rest are the replay's staged payload
+    columns. Buffers grow by doubling (staged depth is a starting size,
+    not a cap: the backpressure plane bounds occupancy in practice, and
+    the legacy FIFO this replaces was unbounded too).
+    """
+
+    def __init__(self, columns, depth: int = 4096,
+                 use_native: bool = True):
+        self._columns = [(tuple(tail), np.dtype(dt)) for tail, dt in columns]
+        self._depth = max(int(depth), 1)
+        self._rows = 0
+        self._bufs = [np.zeros((self._depth,) + tail, dt)
+                      for tail, dt in self._columns]
+        self._row_bytes = np.asarray(
+            [dt.itemsize * int(np.prod(tail, dtype=np.int64))
+             for tail, dt in self._columns], np.int64)
+        self._lib = native.load() if use_native else None
+
+    def __len__(self) -> int:
+        return self._rows
+
+    def _grow(self, need: int) -> None:
+        while self._depth < need:
+            self._depth *= 2
+        grown = []
+        for buf, (tail, dt) in zip(self._bufs, self._columns):
+            new = np.zeros((self._depth,) + tail, dt)
+            new[:self._rows] = buf[:self._rows]
+            grown.append(new)
+        self._bufs = grown
+
+    def append(self, *cols) -> None:
+        """Append one segment (same row count per column) at the cursor.
+
+        Each column is coerced to its declared dtype/contiguity first so
+        the native memcpy and the numpy fallback see identical bytes.
+        """
+        n = len(cols[0])
+        if self._rows + n > self._depth:
+            self._grow(self._rows + n)
+        segs = [np.ascontiguousarray(c, dt).reshape((n,) + tail)
+                for c, (tail, dt) in zip(cols, self._columns)]
+        if self._lib is not None:
+            self._rows = self._lib.staged_append(
+                native.uint8_pp([native.as_uint8_p(b) for b in self._bufs]),
+                native.uint8_pp([native.as_uint8_p(s) for s in segs]),
+                native.as_int64_p(self._row_bytes), len(segs),
+                self._rows, n)
+        else:  # reference semantics — must stay bit-identical
+            for buf, seg in zip(self._bufs, segs):
+                buf[self._rows:self._rows + n] = seg
+            self._rows += n
+
+    def take(self, k: int, outs: list, li: int) -> int:
+        """Drain up to ``k`` oldest rows into flush planes.
+
+        ``outs[c][li, :take]`` receives column ``c``'s head; the
+        remainder compacts to the front (FIFO order preserved, same as
+        the legacy per-flush queue's split-preserving partial takes).
+        """
+        take = min(self._rows, k)
+        if take == 0:
+            return 0
+        rem = self._rows - take
+        for out, buf in zip(outs, self._bufs):
+            out[li, :take] = buf[:take]
+            if rem:
+                buf[:rem] = buf[take:self._rows]
+        self._rows = rem
+        return take
+
+
+class IngestDrain:
+    """Batched host→device transfer thread for a device replay ring.
+
+    Waits until at least ``min_rows`` are staged, then drains them via
+    ``replay.flush()`` under the SHARED replay lock — one traced
+    ``ingest_drain`` hold per batch, off the writer threads. Writers
+    call ``notify()`` (cheap) instead of flushing inline.
+    """
+
+    def __init__(self, replay, lock, min_rows: int, poll_s: float = 0.05):
+        self._replay = replay
+        self._lock = lock
+        self._min = max(int(min_rows), 1)
+        self._poll_s = float(poll_s)
+        self._cv = threading.Condition()
+        self._stop = False
+        self._drained_rows = 0
+        self._drain_flushes = 0
+        self._err: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._run, name="ingest-drain", daemon=True)
+        self._thread.start()
+
+    def notify(self) -> None:
+        with self._cv:
+            self._cv.notify()
+
+    def counters(self) -> dict[str, int]:
+        with self._cv:
+            if self._err is not None:
+                raise RuntimeError("ingest drain thread died") from self._err
+            return {"rows": self._drained_rows,
+                    "flushes": self._drain_flushes}
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop \
+                        and self._replay.pending_rows() < self._min:
+                    self._cv.wait(timeout=self._poll_s)
+                if self._stop:
+                    return
+            try:
+                with tracing.locked(self._lock):
+                    with tracing.span("ingest_drain"):
+                        before = self._replay.pending_rows()
+                        self._replay.flush()
+                        drained = before - self._replay.pending_rows()
+            except BaseException as e:  # surfaced on counters()/close()
+                with self._cv:
+                    self._err = e
+                return
+            with self._cv:
+                self._drained_rows += drained
+                self._drain_flushes += 1
+
+    def close(self) -> None:
+        """Stop the thread; drain any remainder under the lock (so no
+        staged rows are stranded below the chunk threshold), then
+        re-raise a death the thread recorded."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=10)
+        with tracing.locked(self._lock):
+            self._replay.flush()
+        with self._cv:
+            if self._err is not None:
+                raise RuntimeError("ingest drain thread died") from self._err

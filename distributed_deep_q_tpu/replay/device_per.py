@@ -461,6 +461,33 @@ def scatter_priorities(prio: jax.Array, maxp: jax.Array, idx: jax.Array,
     return prio, maxp
 
 
+def insert_meta_pack(staged_u8: jax.Array, maxp: jax.Array, *, k: int,
+                     row_len: int, rowb: int,
+                     alpha: float) -> tuple[jax.Array, jax.Array]:
+    """Device-side insert pack for one staged chunk (ISSUE 8 tentpole
+    part 3): runs per shard inside the fused write program.
+
+    The host used to pad every staged frame row to the DMA stride
+    (``rowb`` bytes, a ``np.zeros`` + slice copy per segment) and view
+    the result as packed int32 — per-row host byte churn on the ingest
+    hot path. Here the raw staged bytes arrive as-is and the program:
+
+    - pads ``[k, row_len]`` u8 rows to the ``rowb`` DMA stride,
+    - packs pixel bytes 4-per-int32 (``bitcast_convert_type`` — on a
+      little-endian host this is bit-identical to the reference's
+      ``padded.view(np.int32)``, which tests pin),
+    - seeds the fresh-row priority from the device running max
+      (``maxp ** α``, the scalar every inserted row shares).
+
+    Returns (flat packed rows ``[k · rowb/4]`` int32, priority seed).
+    """
+    rows = staged_u8.reshape(k, row_len)
+    rows = jnp.pad(rows, ((0, 0), (0, rowb - row_len)))
+    packed = jax.lax.bitcast_convert_type(
+        rows.reshape(k, rowb // 4, 4), jnp.int32)
+    return packed.reshape(-1), maxp ** alpha
+
+
 # ---------------------------------------------------------------------------
 # The replay object: DeviceFrameReplay + device metadata/priority twin
 # ---------------------------------------------------------------------------
@@ -518,8 +545,15 @@ class DevicePERFrameReplay(DeviceFrameReplay):
         self.prioritized = True
         self._cfg = cfg  # base stored the trees-off copy; β fields match
         self.n_step, self.gamma = cfg.n_step, gamma
-        # frame column staged PADDED to the DMA row stride
-        self._stage_columns[0] = ((self.rowb,), np.uint8)
+        # frame column: the columnar path stages RAW rows — padding to
+        # the DMA stride and the 4-per-int32 byte pack happen inside the
+        # jit'd insert program (``insert_meta_pack``), so the host-side
+        # stage is a pure memcpy of the wire payload. The legacy
+        # reference path stages PADDED rows (host zero-fill + .view),
+        # which the device pack is pinned bit-identical against.
+        self._stage_columns[0] = (
+            ((self._row_len,), np.uint8) if self._columnar
+            else ((self.rowb,), np.uint8))
         self._stage_columns += [
             ((), np.int32), ((), np.float32), ((), np.uint8), ((), np.uint8)]
         self._di_cache: tuple[np.ndarray, np.ndarray] | None = None
@@ -567,9 +601,17 @@ class DevicePERFrameReplay(DeviceFrameReplay):
         alpha = float(cfg.priority_alpha)
         k = self.write_chunk
         rowb, interpret = self.rowb, self._interpret
+        row_len, columnar = self._row_len, self._columnar
 
         def write(rows, midx, act, rew, dn, bnd, sidx, didx, staged):
-            new_p = rows.maxp ** alpha
+            if columnar:
+                # device-side meta pack (ISSUE 8 tentpole part 3): raw
+                # staged bytes → padded/packed DMA rows + priority seed
+                staged, new_p = insert_meta_pack(
+                    staged, rows.maxp, k=k, row_len=row_len, rowb=rowb,
+                    alpha=alpha)
+            else:
+                new_p = rows.maxp ** alpha
             frames = scatter_rows(sidx, didx, staged, rows.frames,
                                   n=2 * k, rowb=rowb, interpret=interpret)
             return DeviceReplayState(
@@ -639,20 +681,23 @@ class DevicePERFrameReplay(DeviceFrameReplay):
     # -- overridden write plumbing ------------------------------------------
 
     def _stage(self, slot: int, local, frames_arr) -> None:
-        """Stage (rows, PADDED frames, action, reward, done, boundary) —
-        the metadata comes from the host slot arrays the rows were just
-        written to, gathered vectorized (fancy indexing copies)."""
+        """Stage (rows, frames, action, reward, done, boundary) — the
+        metadata comes from the host slot arrays the rows were just
+        written to, gathered vectorized (fancy indexing copies).
+        Columnar staging takes the frame rows RAW (pad/pack moved into
+        the device insert program); the legacy reference pads here."""
         m = self.slots[slot]
         shard, base_off = self._slot_base(slot)
         k = len(local)
-        padded = np.zeros((k, self.rowb), np.uint8)
-        padded[:, :self._row_len] = frames_arr
-        self._pending[shard].append((
-            (base_off + local).astype(np.int32), padded,
-            m.action[local], m.reward[local],
+        if self._columnar:
+            frames_col = frames_arr
+        else:
+            frames_col = np.zeros((k, self.rowb), np.uint8)
+            frames_col[:, :self._row_len] = frames_arr
+        self._stage_rows(shard, (base_off + local).astype(np.int32), (
+            frames_col, m.action[local], m.reward[local],
             m.done[local].astype(np.uint8),
             m.boundary[local].astype(np.uint8)))
-        self._pending_rows[shard] += k
         self._di_cache = None  # cursors/sizes moved
 
     def _apply_write(self, idx, cols) -> None:
@@ -677,8 +722,12 @@ class DevicePERFrameReplay(DeviceFrameReplay):
         src = np.tile(np.arange(k, dtype=np.int32), (dl, 1))
         sidx = np.concatenate([src, src], axis=1)
         didx = np.concatenate([main, ghost], axis=1).astype(np.int32)
-        staged = np.ascontiguousarray(cols[0]).reshape(dl, -1).view(
-            np.int32)
+        if self._columnar:
+            # raw u8 rows; insert_meta_pack pads + packs them on device
+            staged = np.ascontiguousarray(cols[0]).reshape(dl, -1)
+        else:
+            staged = np.ascontiguousarray(cols[0]).reshape(dl, -1).view(
+                np.int32)
         self.dstate = self._write_full(
             self.dstate,
             self.to_global(idx.reshape(-1)),

@@ -45,6 +45,7 @@ import numpy as np
 from distributed_deep_q_tpu.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_deep_q_tpu import tracing
 from distributed_deep_q_tpu.config import ReplayConfig
 from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
 from distributed_deep_q_tpu.replay.prioritized import (
@@ -159,15 +160,24 @@ class DeviceFrameReplay:
         self._row_len = int(np.prod(self.frame_shape))
         self._alloc_ring()
 
-        # host staging: per-shard FIFO of (in-shard offsets [n], *columns)
-        # array chunks — array-granular so actor-rate ingest costs
-        # O(segments), not O(rows), of Python (the ReplayFeed hot path).
-        # _stage_columns describes the columns' (tail shape, dtype);
-        # subclasses (device_per) extend it with metadata columns.
+        # host staging: _stage_columns describes the staged columns'
+        # (tail shape, dtype); subclasses (device_per) extend it with
+        # metadata columns. Two interchangeable backends (ISSUE 8):
+        # - columnar (default): per-shard preallocated column buffers,
+        #   one memcpy per column per staged segment (replay/columnar.py)
+        # - legacy: per-shard FIFO of (in-shard offsets [n], *columns)
+        #   array tuples — the bit-identical reference the columnar
+        #   path is pinned against (tests/test_columnar_ingest.py)
         self._stage_columns: list[tuple[tuple[int, ...], type]] = [
             ((self._row_len,), np.uint8)]
+        self._columnar = bool(getattr(cfg, "staging_columnar", True))
+        self._staging_depth = int(getattr(cfg, "staging_depth", 4096))
+        self._stages: list | None = None  # built lazily: subclasses widen
         self._pending: list[list[tuple]] = [[] for _ in range(d)]
         self._pending_rows = [0] * d
+        self._drain = None  # optional IngestDrain (start_drain)
+        self._drain_enabled = bool(getattr(cfg, "ingest_drain", True))
+        self._drain_min = int(getattr(cfg, "drain_min_rows", 0))
 
     def _alloc_ring(self) -> None:
         """Allocate the HBM frame plane + its scatter-writer. Overridden by
@@ -253,6 +263,27 @@ class DeviceFrameReplay:
 
     # -- write path ---------------------------------------------------------
 
+    def _stage_rows(self, shard: int, idx: np.ndarray, cols: tuple) -> None:
+        """Append one staged segment (in-shard offsets + payload columns)
+        to the shard's staging backend. Columnar: one memcpy per column
+        into the preallocated stage (``staged_append``); legacy: FIFO of
+        array tuples. Callers hold the replay lock."""
+        if self._columnar:
+            if self._stages is None:
+                self._stages = [None] * self.num_shards
+            st = self._stages[shard]
+            if st is None:
+                from distributed_deep_q_tpu.replay.columnar import ColumnStage
+                st = self._stages[shard] = ColumnStage(
+                    [((), np.int32)] + list(self._stage_columns),
+                    depth=self._staging_depth,
+                    use_native=self._cfg.use_native)
+            with tracing.span("staged_append"):
+                st.append(idx, *cols)
+        else:
+            self._pending[shard].append((idx,) + tuple(cols))
+        self._pending_rows[shard] += len(idx)
+
     def _stage(self, slot: int, local: np.ndarray, frames: np.ndarray) -> None:
         """Queue (slot-local rows, flat frames) for the HBM scatter and set
         their fresh-row priorities."""
@@ -261,9 +292,7 @@ class DeviceFrameReplay:
                 local, np.full(len(local),
                                self.max_priority ** self._cfg.priority_alpha))
         shard, base = self._slot_base(slot)
-        self._pending[shard].append(
-            ((base + local).astype(np.int32), frames))
-        self._pending_rows[shard] += len(local)
+        self._stage_rows(shard, (base + local).astype(np.int32), (frames,))
 
     def add(self, frame, action, reward, done, boundary=None) -> int:
         """Single-stream add (in-process training loop)."""
@@ -276,9 +305,7 @@ class DeviceFrameReplay:
             # episode finished → move this stream to its next slot, so one
             # stream eventually reaches every shard it owns
             self._stream_pos[0] += 1
-        if max(self._pending_rows) >= self.write_chunk \
-                and not self.defer_flush:
-            self.flush()
+        self._flush_or_notify()
         return int(self._global_index(slot, np.asarray(i)))
 
     def add_batch(self, batch, stream: int = 0) -> np.ndarray:
@@ -318,10 +345,41 @@ class DeviceFrameReplay:
             if boundary[s1 - 1]:
                 self._stream_pos[stream] += 1
             s0 = s1
-        if max(self._pending_rows) >= self.write_chunk \
-                and not self.defer_flush:
-            self.flush()
+        self._flush_or_notify()
         return out
+
+    def _flush_or_notify(self) -> None:
+        """Chunk-boundary flush gate. With an ``IngestDrain`` attached
+        the writer only nudges the drain thread (the dispatch happens
+        there, off this thread's lock hold); otherwise the legacy
+        inline flush runs here."""
+        if max(self._pending_rows) < self.write_chunk or self.defer_flush:
+            return
+        if self._drain is not None:
+            self._drain.notify()
+        else:
+            self.flush()
+
+    def start_drain(self, lock, min_rows: int | None = None):
+        """Attach a background staging→device drain thread sharing
+        ``lock`` (the caller's replay lock — mutual exclusion with
+        writers and the sampler is unchanged). Returns the drain, or
+        None when disabled by config or on multi-host meshes (flushes
+        there are lockstep collectives every process must enter at the
+        same loop point — a free-running thread cannot)."""
+        if self._drain is not None:
+            return self._drain
+        if not self._drain_enabled or self.defer_flush:
+            return None
+        from distributed_deep_q_tpu.replay.columnar import IngestDrain
+        self._drain = IngestDrain(
+            self, lock, min_rows or max(self.write_chunk, self._drain_min))
+        return self._drain
+
+    def stop_drain(self) -> None:
+        drain, self._drain = self._drain, None
+        if drain is not None:
+            drain.close()
 
     def reset_stream(self, stream: int) -> None:
         """Seal the stream's current slot at a writer identity change
@@ -365,6 +423,13 @@ class DeviceFrameReplay:
             cols = [np.zeros((dl, k) + tail, dt)
                     for tail, dt in self._stage_columns]
             for li, s in enumerate(shards):
+                if self._columnar:
+                    st = (self._stages[s]
+                          if self._stages is not None else None)
+                    if st is not None:
+                        self._pending_rows[s] -= st.take(
+                            k, [idx] + cols, li)
+                    continue
                 fill = 0
                 while self._pending[s] and fill < k:
                     entry = self._pending[s][0]

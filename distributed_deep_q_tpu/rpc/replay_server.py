@@ -321,6 +321,14 @@ class ReplayFeedServer:
             self._restore(snapshot_path)
 
         self.flow.start_watchdog()
+        # device-resident replay tiers expose start_drain: a background
+        # staging→device transfer thread sharing replay_lock, so serve
+        # threads pay a cursor bump + notify instead of the HBM dispatch
+        # (ISSUE 8). Host-tier replays have no staged plane — no drain.
+        self._drain = None
+        start_drain = getattr(self.replay, "start_drain", None)
+        if start_drain is not None:
+            self._drain = start_drain(self.replay_lock)
         self._sock = socket.create_server((host, port))
         self.address = self._sock.getsockname()
         self._stop = threading.Event()
@@ -401,6 +409,11 @@ class ReplayFeedServer:
             except OSError:
                 pass
         self.flow.close()
+        if self._drain is not None:
+            with self.replay_lock:
+                replay = self.replay
+            replay.stop_drain()
+            self._drain = None
 
     # -- restart survival ---------------------------------------------------
     #
@@ -773,6 +786,20 @@ class ReplayFeedServer:
             n = int(req.get("env_steps", len(req["action"])))
         else:
             n = len(req["action"])
+        # off-lock parse/prep (ISSUE 8 satellite): scalar conversions,
+        # episode-return unpacking, and lineage stamp prep read only the
+        # request — the hold below used to cover all of it, serializing
+        # every serve thread behind pure-Python parsing. Only ring-state
+        # mutation remains under the lock; the shape is pinned by
+        # tests/test_columnar_ingest.py::test_add_transitions_lock_shape
+        with tracing.span("ingest_parse"):
+            seq = int(req.get("flush_seq", -1))
+            episodes = int(req.get("episodes", 0))
+            ep_returns = [float(r) for r in np.atleast_1d(
+                req.get("ep_returns", np.zeros(0, np.float32)))]
+            births = req.get(tracing.KEY_BIRTH)
+            if births is not None:
+                births = np.atleast_1d(births).astype(np.float64)
         with tracing.locked(self.replay_lock):
             # idempotent-flush dedup: a resilient client resends a
             # failed flush with the SAME flush_seq; if the first send
@@ -781,7 +808,6 @@ class ReplayFeedServer:
             # or replay would hold duplicated transitions. Dedup wins
             # over admission: the data is already in, shedding the
             # retry would only make the client resend a third time
-            seq = int(req.get("flush_seq", -1))
             if seq >= 0 and actor_id >= 0 \
                     and seq <= self._flush_seq.get(actor_id, -1):
                 self.telemetry.record_duplicate_flush()
@@ -824,16 +850,14 @@ class ReplayFeedServer:
                          ("obs", "action", "reward", "next_obs",
                           "discount")})
             self.env_steps += n
-            self.episodes += int(req.get("episodes", 0))
-            for r in np.atleast_1d(req.get("ep_returns",
-                                           np.zeros(0, np.float32))):
-                self.returns.append(float(r))
+            self.episodes += episodes
+            self.returns.extend(ep_returns)
             # stamp AFTER the insert succeeded: a failed insert must
             # leave the seq unclaimed (the client is told via the
             # error dict; only a clean landing may absorb its retries)
             if seq >= 0 and actor_id >= 0:
                 self._flush_seq[actor_id] = seq
-            self._record_lineage(req, idx)
+            self._record_lineage(births, idx)
             self.flow.on_ingest(actor_id, n)
             credits = self.flow.grant(actor_id)
             total = self.env_steps
@@ -853,16 +877,16 @@ class ReplayFeedServer:
             return {}
         return {tracing.KEY_RECV_AT: t2, tracing.KEY_DONE_AT: tracing.now()}
 
-    def _record_lineage(self, req: dict[str, Any], idx) -> None:
+    def _record_lineage(self, births: np.ndarray | None, idx) -> None:
         """Map written ring slots → (birth stamp, env_steps at insert) for
-        the learner's ``time_to_learn`` lookup. Caller holds
-        ``replay_lock``. Only host replay tiers return slot indices from
+        the learner's ``time_to_learn`` lookup. ``births`` arrives
+        pre-parsed (float64, off-lock — ISSUE 8 satellite); caller holds
+        ``replay_lock`` for the stamp writes, which pair with the ring
+        state. Only host replay tiers return slot indices from
         ``add_batch``; device/fused tiers fall back to the flush-level
         ``trace/ingest_lag_ms`` histogram in ``ServerTelemetry``."""
-        births = req.get(tracing.KEY_BIRTH)
         if births is None or not isinstance(idx, np.ndarray):
             return
-        births = np.atleast_1d(births).astype(np.float64)
         slots = np.ravel(idx)
         if slots.size != births.size:
             # sequence batches write slots ≠ rows (overlapping windows);
@@ -917,6 +941,10 @@ class ReplayFeedServer:
                 if pending is not None:
                     out["queue/staged_rows"] = int(pending())
         out["fleet/actors_seen"] = len(self.last_seen)
+        if self._drain is not None:
+            dc = self._drain.counters()
+            out["ingest/drained_rows"] = dc["rows"]
+            out["ingest/drain_flushes"] = dc["flushes"]
         fc = self.flow.counters()
         out["flow/degraded"] = fc["degraded"]
         out["flow/degraded_trips"] = fc["degraded_trips"]

@@ -61,7 +61,10 @@ STAGES = (
     "wire_decode",     # frame bytes → message dict
     "lock_wait",       # waiting to acquire a traced lock
     "lock_hold",       # critical section under a traced lock
+    "ingest_parse",    # add_transitions payload parse/prep, OFF-lock
     "ring_insert",     # replay add_batch under replay_lock
+    "staged_append",   # columnar stage memcpy (replay/columnar.py)
+    "ingest_drain",    # batched staging→device flush (drain thread)
     "sample",          # replay sample (host compose / device draw)
     "stage_batch",     # DeviceStager cycle (sample + device_put)
     "device_put",      # host→device transfer of a sampled batch

@@ -12,11 +12,15 @@ op-count up) by more than its tolerance — the larger recorded ``spread``
 of the two runs when one exists (benches record run-to-run relative
 spread next to gated metrics), else ``--tolerance`` (default 2%).
 
-Keys listed under ``tunnel_bound_keys`` in either file are measurements
-of the benchmarking transport, not of the system (EVAL_PROTOCOL.md) —
-their regressions are ANNOTATED but never fail the diff. Exit status is
-1 iff a non-tunnel-bound metric regressed; stdlib only, no repo imports,
-so it runs anywhere the jsons land.
+Keys listed under ``tunnel_bound_keys`` are measurements of the
+benchmarking transport, not of the system (EVAL_PROTOCOL.md) — their
+regressions are ANNOTATED but never fail the diff. The CANDIDATE run's
+list wins (falling back to the baseline's when absent): when a bench
+graduates a key out of the tunnel set — e.g. ``ingest_curve`` once the
+columnar drain made it learner-bound — diffs against old baselines gate
+it immediately. Exit status is 1 iff a non-tunnel-bound metric
+regressed; stdlib only, no repo imports, so it runs anywhere the jsons
+land.
 """
 
 from __future__ import annotations
@@ -38,9 +42,11 @@ SPREAD_KEY = {
 _LOWER_BETTER = ("_ms", "_fusions", "_convs", "_copies", "fusions",
                  "spread")
 # keys that are configuration echoes / identities, not metrics
+# (max_in_flight_rows is the writers' backpressure watermark — a state
+# echo of the pacing loop, not a quality axis with a bad direction)
 _SKIP = ("_chain_k", "_vs_", "vs_baseline", "ring_capacity",
          "flagship_batch", "concurrent_writers", "peak_flops", "n", "rc",
-         "flops_per_step")
+         "flops_per_step", "max_in_flight_rows")
 
 
 def _parsed(path: str) -> dict:
@@ -83,8 +89,11 @@ def _flatten(d: dict, prefix: str = "") -> dict:
 def diff(a: dict, b: dict, tolerance: float):
     """-> (rows, failed). Each row: (key, old, new, rel_delta, tol,
     status) with status in {ok, improved, regressed, tunnel-bound}."""
-    tunnel = set(a.get("tunnel_bound_keys", []) or [])
-    tunnel |= set(b.get("tunnel_bound_keys", []) or [])
+    # candidate's tunnel list wins: a bench that PROMOTES a key out of
+    # the tunnel set (ingest_curve, ISSUE 8) starts gating it even
+    # against baselines that still listed it
+    tunnel = set(b.get("tunnel_bound_keys")
+                 or a.get("tunnel_bound_keys") or [])
     fa, fb = _flatten(a), _flatten(b)
     rows, failed = [], False
     for key in sorted(fa.keys() & fb.keys()):

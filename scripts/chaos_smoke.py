@@ -1,6 +1,6 @@
 """Chaos smoke — prove the RPC fault-tolerance stack end to end.
 
-Four modes:
+Five modes:
 
 ``python scripts/chaos_smoke.py [num_actors] [spec]`` (default)
     Threaded actor fleet over the production wire protocol: resilient
@@ -21,6 +21,17 @@ Four modes:
     (the shed flush re-stages under its original ``flush_seq``), sheds
     actually fired, and the clients' token buckets paced to the granted
     credits. Chaos delays compose on top via the optional spec.
+
+``python scripts/chaos_smoke.py ingest [spec]``
+    Ingest-saturation acceptance (ISSUE 8): a producer fleet streams
+    LABELED pixel frames into a device replay ring through the full
+    columnar path — wire decode → ``ColumnStage`` staged-append →
+    ``IngestDrain`` batched flush — faster than a rate-capped consumer,
+    so the admission controller must shed. The gate is the overload
+    contract held at saturation through the NEW staging plane: sheds
+    fired, the drain (not the writers) carried the flushes, and every
+    frame landed in the HBM ring exactly once (ids decoded back out of
+    the ring rows).
 
 ``python scripts/chaos_smoke.py durability [cycles] [spec]``
     Crash-recovery acceptance (ISSUE 6): the server is hard-killed at
@@ -303,6 +314,147 @@ def run_overload_smoke(num_actors: int = 3, flushes: int = 40, rows: int = 16,
     return verdict
 
 
+def run_ingest_saturation_smoke(num_actors: int = 3, flushes: int = 40,
+                                rows: int = 16,
+                                spec: str = "delay=0.05:20,seed=17",
+                                consume_rate: float = 300.0,
+                                deadline: float = 120.0) -> dict:
+    """Overload contract at saturation through the columnar ingest path.
+
+    Same shed-but-never-lost acceptance as ``overload``, but the replay
+    is a DEVICE ring fed through the full ISSUE 8 plane: frame batches
+    decode off the wire, staged-append into per-shard ``ColumnStage``
+    buffers under the replay lock, and the ``IngestDrain`` thread (which
+    the server attaches at boot) batches the H2D flushes. Every frame
+    carries its id in its first four pixel bytes, so after shutdown the
+    HBM ring itself answers lost/duplicated exactly — a dedup slip or a
+    drain/staging race would surface as a wrong multiset of ids."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_deep_q_tpu.config import MeshConfig, ReplayConfig
+    from distributed_deep_q_tpu.parallel.mesh import make_mesh
+    from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
+    from distributed_deep_q_tpu.rpc import faultinject
+    from distributed_deep_q_tpu.rpc.flowcontrol import FlowConfig
+    from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedServer
+    from distributed_deep_q_tpu.rpc.resilience import (
+        ResilientReplayFeedClient, RetryPolicy)
+
+    trc = _trace_begin()
+    plan = faultinject.install(spec) if spec else None
+    total = num_actors * flushes * rows
+    mesh = make_mesh(MeshConfig(backend="cpu", num_fake_devices=8, dp=1))
+    # capacity sized so no slot wraps (exactly-once stays decidable from
+    # final ring contents); one stream slot per actor
+    cfg = ReplayConfig(capacity=6144, batch_size=32, prioritized=False)
+    replay = DeviceFrameReplay(cfg, mesh, (8, 8), stack=4, gamma=0.99,
+                               seed=0, write_chunk=64,
+                               num_streams=num_actors)
+    flow = FlowConfig(ingest_factor=1.5, flush_credit_floor=8,
+                      rate_halflife_s=0.5)
+    server = ReplayFeedServer(replay, flow=flow)
+    host, port = server.address
+    policy = RetryPolicy(base_delay=0.01, max_delay=0.2, deadline=deadline)
+    errors: list[str] = []
+    stop = threading.Event()
+    clients: list = [None] * num_actors
+
+    def consumer() -> None:
+        # rate-capped learner stand-in: only the consumption EWMA matters
+        # here (device sampling is exercised elsewhere)
+        batch = 32
+        while not stop.is_set():
+            server.note_consumed(batch)
+            time.sleep(batch / consume_rate)
+
+    def frame_ids(aid: int, f: int) -> np.ndarray:
+        # non-zero ids (unwritten ring rows read back as zeros)
+        return ((aid + 1) * 1_000_000 + f * 1_000
+                + np.arange(rows, dtype=np.uint32))
+
+    def actor(aid: int) -> None:
+        try:
+            c = ResilientReplayFeedClient.connect(
+                host, port, actor_id=aid, policy=policy, seed=300 + aid)
+            clients[aid] = c
+            for f in range(flushes):  # no pacing: outrun the consumer
+                frames = np.zeros((rows, 8, 8), np.uint8)
+                frames.reshape(rows, 64)[:, :4] = \
+                    frame_ids(aid, f).view(np.uint8).reshape(rows, 4)
+                c.add_transitions(
+                    frame=frames, action=np.zeros(rows, np.int32),
+                    reward=np.zeros(rows, np.float32),
+                    done=np.zeros(rows, bool),
+                    boundary=np.zeros(rows, bool))
+            c.close()
+        except Exception as e:  # noqa: BLE001 — reported in the verdict
+            errors.append(f"actor {aid}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=actor, args=(a,), daemon=True)
+               for a in range(num_actors)]
+    pacer = threading.Thread(target=consumer, daemon=True)
+    t0 = time.perf_counter()
+    pacer.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=deadline)
+    hung = sum(t.is_alive() for t in threads)
+    stop.set()
+    pacer.join(timeout=5)
+    wall = time.perf_counter() - t0
+
+    rpc = server.telemetry.robustness_counters()
+    drained = server.telemetry_summary()
+    server.close()  # stops the drain; its shutdown flush lands stragglers
+    if plan:
+        faultinject.uninstall()
+
+    expected = {int(i) for a in range(num_actors) for f in range(flushes)
+                for i in frame_ids(a, f)}
+    ring = np.asarray(replay.ring)  # [capacity, 64] uint8
+    ids = np.ascontiguousarray(ring[:, :4]).view(np.uint32).ravel()
+    observed = ids[ids > 0].astype(np.int64).tolist()
+    lost = len(expected - set(observed))
+    duplicated = len(observed) - len(set(observed))
+    corrupt = len(set(observed) - expected)
+    client_sheds = sum(c.sheds for c in clients if c is not None)
+    verdict = {
+        # the acceptance: saturation produced sheds, the drain thread
+        # carried the flushes, and the ring holds every id exactly once
+        "ok": (not errors and not hung and lost == 0 and duplicated == 0
+               and corrupt == 0 and rpc["shed_flushes"] > 0
+               and drained.get("ingest/drain_flushes", 0) > 0
+               and replay.pending_rows() == 0),
+        "num_actors": num_actors,
+        "transitions_sent": total,
+        "transitions_stored": len(observed),
+        "lost": lost,
+        "duplicated": duplicated,
+        "corrupt_rows": corrupt,
+        "shed_flushes": rpc["shed_flushes"],
+        "client_sheds": client_sheds,
+        "drained_rows": drained.get("ingest/drained_rows", 0),
+        "drain_flushes": drained.get("ingest/drain_flushes", 0),
+        "rows_left_staged": replay.pending_rows(),
+        "duplicate_flushes_absorbed": rpc["duplicate_flushes"],
+        "consume_rate_cap": consume_rate,
+        "chaos_spec": spec,
+        "faults_fired": dict(sorted(plan.counters.items())) if plan else {},
+        "hung_actors": hung,
+        "errors": errors,
+        "wall_s": round(wall, 2),
+    }
+    trace = _trace_verdict(trc)
+    verdict["trace"] = trace
+    verdict["ok"] = (verdict["ok"] and trace["orphan_spans"] == 0
+                     and (client_sheds == 0
+                          or trace["instants"].get("shed", 0) > 0))
+    return verdict
+
+
 def run_durability_smoke(cycles: int = 20, num_actors: int = 3,
                          flushes_per_cycle: int = 4, rows: int = 8,
                          spec: str = "torn=0.35,corrupt=0.03,seed=23",
@@ -524,6 +676,11 @@ if __name__ == "__main__":
         if len(args) > 2:
             kwargs["spec"] = args[2]
         verdict = run_durability_smoke(**kwargs)
+        print(json.dumps(verdict))
+        sys.exit(0 if verdict["ok"] else 1)
+    if args and args[0] in ("ingest", "--ingest", "saturation"):
+        verdict = run_ingest_saturation_smoke(
+            spec=args[1] if len(args) > 1 else "delay=0.05:20,seed=17")
         print(json.dumps(verdict))
         sys.exit(0 if verdict["ok"] else 1)
     if args and args[0] in ("overload", "--overload"):

@@ -35,6 +35,7 @@ from distributed_deep_q_tpu.config import (
 FUSED_BODY_BUDGET = (60, 12, 8)     # acceptance bar; measured 60/8/6
 B32_STEP_BUDGET = (125, 8, 6)       # measured 117/8/3
 R2D2_PROGRAM_BUDGET = (215, 8, 55)  # measured 202/8/51
+META_PACK_BUDGET = (4, 0, 2)        # measured 2/0/0 (ISSUE 8)
 
 
 def _assert_within(census, budget, label):
@@ -119,6 +120,28 @@ def test_fused_chain_body_budget(transition_solver):
         solver.learner._build_device_per_step(spec, chain)
     census = fused_train_census(solver, replay, chain)
     _assert_within(census, FUSED_BODY_BUDGET, "fused chain body")
+
+
+def test_insert_meta_pack_budget():
+    """Device-side meta pack (columnar ingest, ISSUE 8): the pad +
+    bitcast + priority-seed program that replaced the per-row host
+    numpy pack must stay a couple of fusions — it runs on EVERY flush,
+    so any op that creeps in here is paid at ingest rate, not grad
+    rate."""
+    import functools
+
+    from distributed_deep_q_tpu.ops.ring_gather import padded_row_bytes
+    from distributed_deep_q_tpu.profiling import hlo_op_census
+    from distributed_deep_q_tpu.replay.device_per import insert_meta_pack
+
+    k, row_len = 64, 84 * 84 + 11  # flagship-row-shaped, not special
+    rowb = padded_row_bytes(row_len)
+    fn = jax.jit(functools.partial(insert_meta_pack, k=k, row_len=row_len,
+                                   rowb=rowb, alpha=0.6))
+    text = fn.lower(jnp.zeros((k, row_len), jnp.uint8),
+                    jnp.float32(1.0)).compile().as_text()
+    _assert_within(hlo_op_census(text), META_PACK_BUDGET,
+                   "insert meta pack")
 
 
 @pytest.fixture(scope="module")
