@@ -281,6 +281,78 @@ class _ActorComms:
         return out
 
 
+class _RemoteInference:
+    """Exploit-action source for ``remote_inference`` mode (ISSUE 9): the
+    actor ships observations to the ``InferenceServer`` and receives
+    argmax actions — zero steady-state param pulls, staleness eliminated
+    by construction (every action is computed against the server's live
+    θ). ε-greedy stays OUT of this class, on the actor's own seeded rng,
+    so the exploration stream is bitwise identical to local inference.
+
+    Transport rides the resilient wrapper (reconnect/backoff, credit
+    grants feed its token bucket) and honors explicit shed replies with
+    the server's retry hint. An infer is a pure function of (θ, obs), so
+    a re-send after a shed or an ambiguous transport failure is
+    idempotent for free — no flush_seq machinery needed."""
+
+    def __init__(self, cfg: Config, stop_event, actor_id: int, gid: int,
+                 touch=None):
+        from distributed_deep_q_tpu.rpc.inference_server import \
+            InferenceClient
+        from distributed_deep_q_tpu.rpc.resilience import (
+            ResilientReplayFeedClient, RetryPolicy)
+
+        policy = RetryPolicy(base_delay=cfg.actors.rpc_retry_base,
+                             max_delay=cfg.actors.rpc_retry_max,
+                             deadline=cfg.actors.rpc_retry_deadline)
+        # retries on the INITIAL connect too: the inference server comes
+        # up with the rest of the learner plane, maybe after this child
+        seed = cfg.train.seed + 60217 * (gid + 1)
+        rng = np.random.default_rng(seed)
+        stub = policy.run(
+            lambda: InferenceClient(cfg.inference.host, cfg.inference.port,
+                                    actor_id=actor_id,
+                                    timeout=cfg.actors.rpc_call_timeout),
+            rng=rng, should_abort=stop_event.is_set)
+        self._client = ResilientReplayFeedClient(
+            stub, policy, should_abort=stop_event.is_set, seed=seed)
+        self._client.on_backpressure = touch
+        self._rng = rng
+        self._seq = 0
+        self.version = -1
+        self.sheds = 0
+
+    def action(self, obs) -> int:
+        """One remote argmax action for a single observation."""
+        batch = np.ascontiguousarray(np.asarray(obs)[None])
+        seq = self._seq
+        self._seq += 1
+        while True:
+            with tracing.span("rpc_call"):
+                resp = self._client.call("infer", obs=batch, seq=seq)
+            if resp.get("error"):
+                from distributed_deep_q_tpu.rpc.resilience import RPCError
+                raise RPCError(f"infer rejected: {resp['error']}")
+            if resp.get("shed"):
+                self.sheds += 1
+                tracing.instant(
+                    "shed", plane="inference",
+                    retry_after_ms=float(resp.get("retry_after_ms", 0)))
+                delay = max(float(resp.get("retry_after_ms", 100)),
+                            10.0) / 1e3
+                # decorrelate the fleet's re-sends a little
+                delay *= 1.0 + 0.25 * float(self._rng.random())
+                self._client._sleep_backpressure(delay)
+                continue
+            self._client._note_reply(resp)
+            if resp.get("version") is not None:
+                self.version = int(resp["version"])
+            return int(np.asarray(resp["actions"])[0])
+
+    def close(self) -> None:
+        self._client.close()
+
+
 # ---------------------------------------------------------------------------
 # Actor process
 # ---------------------------------------------------------------------------
@@ -416,14 +488,29 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     # credit throttling / SHED waits advance the liveness watermark: a
     # backpressured actor is waiting on purpose, not wedged
     client.on_backpressure = comms.touch
+    remote = None
+    if cfg.inference.enabled:
+        # remote_inference mode (ISSUE 9): exploit actions come from the
+        # batched inference plane; this actor never pulls θ again
+        remote = _RemoteInference(cfg, stop_event, actor_id, gid,
+                                  touch=comms.touch)
     try:
         while not stop_event.is_set():
             if max_env_steps and steps >= max_env_steps:
                 break
-            comms.maybe_pull(steps)
+            if remote is None:
+                comms.maybe_pull(steps)
+            else:
+                comms.touch()  # loop progress for the heartbeat gate
 
+            # ε-greedy stays local either way: the SAME rng draws in the
+            # SAME order, so the exploration stream is bitwise identical
+            # between local and remote inference
             if rng.random() < eps:
                 a = int(rng.integers(env.num_actions))
+            elif remote is not None:
+                with tracing.span_sampled("remote_infer"):
+                    a = remote.action(obs)
             else:
                 a = qnet.argmax_action(np.asarray(obs))
             with tracing.span_sampled("env_step"):
@@ -473,6 +560,8 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
         pass  # learner gone; supervisor owns our lifecycle
     finally:
         comms.close()
+        if remote is not None:
+            remote.close()
         client.close()
         if tracing.ENABLED:
             tracing.export()
@@ -693,11 +782,17 @@ class ActorSupervisor:
 # ---------------------------------------------------------------------------
 
 
-def _bring_up_rpc_plane(cfg: Config, replay):
+def _bring_up_rpc_plane(cfg: Config, replay, obs_dim: int = 4):
     """Server + supervised fleet, with the fault-tolerance plumbing:
     chaos spec exported for the spawned actors to inherit, warm boot from
     ``train.server_snapshot_path`` (stable port when snapshotting — a
-    restarted learner must come back where the fleet expects it)."""
+    restarted learner must come back where the fleet expects it).
+
+    When ``inference.enabled`` the batched inference plane comes up
+    alongside the replay feed: its bound address is written back into
+    ``cfg.inference`` BEFORE the supervisor is constructed, because the
+    fleet learns the address through the cfg pickled into each spawned
+    child. Returns ``(server, sup, infer_server-or-None)``."""
     from distributed_deep_q_tpu.rpc import faultinject
     from distributed_deep_q_tpu.rpc.flowcontrol import FlowConfig
     from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedServer
@@ -714,15 +809,43 @@ def _bring_up_rpc_plane(cfg: Config, replay):
                               port=cfg.actors.port if snap else 0,
                               snapshot_path=snap, flow=flow,
                               snapshot_keep=cfg.train.snapshot_keep)
+    infer_server = None
+    if cfg.inference.enabled and cfg.net.kind != "r2d2":
+        from distributed_deep_q_tpu.models.policy import BatchedPolicy
+        from distributed_deep_q_tpu.rpc.inference_server import \
+            InferenceServer
+        policy = BatchedPolicy(cfg.net, seed=cfg.train.seed,
+                               obs_dim=obs_dim,
+                               buckets=cfg.inference.buckets)
+        infer_server = InferenceServer(
+            policy, host=cfg.inference.host, port=cfg.inference.port,
+            max_batch=cfg.inference.max_batch,
+            cutoff_us=cfg.inference.cutoff_us,
+            flow=FlowConfig(
+                staged_high_watermark=cfg.inference.queue_high_watermark,
+                shed_policy=cfg.replay.shed_policy))
+        cfg.inference.host, cfg.inference.port = infer_server.address
     host, port = server.address
     sup = ActorSupervisor(cfg, host, port)
     sup.start()
     sup.watch(server.last_seen)
-    return server, sup
+    return server, sup, infer_server
 
 
-def _tear_down_rpc_plane(cfg: Config, server, sup) -> None:
+def _publish_weights(server, infer_server, weights) -> None:
+    """One θ publish across both planes: the replay feed's cached wire
+    frame (local-inference pulls) and the inference server's in-process
+    install, tied to the SAME version number so actors on either plane
+    agree on what \"current\" means."""
+    version = server.publish_params(weights)
+    if infer_server is not None:
+        infer_server.set_params(weights, version=version)
+
+
+def _tear_down_rpc_plane(cfg: Config, server, sup, infer_server=None) -> None:
     sup.stop()
+    if infer_server is not None:
+        infer_server.close()
     snap = cfg.train.server_snapshot_path
     if snap:
         server.shutdown(snap)  # quiesce + snapshot for the next warm boot
@@ -805,8 +928,9 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                          seed=cfg.train.seed),
             replay_cfg, seed=cfg.train.seed)
 
-    server, sup = _bring_up_rpc_plane(cfg, replay)
-    server.publish_params(solver.get_weights())
+    server, sup, infer_server = _bring_up_rpc_plane(
+        cfg, replay, obs_dim=int(np.prod(obs_shape)))
+    _publish_weights(server, infer_server, solver.get_weights())
 
     fused_per = isinstance(replay, DevicePERFrameReplay)
     writeback = None
@@ -829,7 +953,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     ckpt = maybe_checkpointer(cfg.train)
     if ckpt and cfg.train.resume and ckpt.latest_step() is not None:
         solver.state, _ = ckpt.restore(solver.state)
-        server.publish_params(solver.get_weights())
+        _publish_weights(server, infer_server, solver.get_weights())
     stager = None
     try:
         # wait for warm-up fill (actors are streaming meanwhile). Multi-
@@ -918,7 +1042,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
 
             if gstep % cfg.actors.param_sync_period == 0:
                 t0 = time.perf_counter()
-                server.publish_params(solver.get_weights())
+                _publish_weights(server, infer_server, solver.get_weights())
                 metrics.observe("learner/publish_params_ms",
                                 1e3 * (time.perf_counter() - t0))
 
@@ -947,14 +1071,16 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                 # one record carries the whole telemetry spine: per-phase
                 # times, per-RPC-method latency/size percentiles, queue
                 # gauges, and the fleet counters actors flushed back
+                infer_tm = (infer_server.telemetry_summary()
+                            if infer_server is not None else {})
                 metrics.log(gstep, **summary, **timer.summary(),
-                            **server.telemetry_summary(),
+                            **server.telemetry_summary(), **infer_tm,
                             **metrics.telemetry())
     finally:
         trace.close()
         if stager is not None:
             stager.close()
-        _tear_down_rpc_plane(cfg, server, sup)
+        _tear_down_rpc_plane(cfg, server, sup, infer_server)
         if tracing.ENABLED:
             tracing.export()  # learner-process shard (actors wrote theirs)
 
@@ -973,6 +1099,18 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     summary["rpc_checksum_errors"] = rpc["checksum_errors"]
     summary["snapshot_quarantined"] = rpc["snapshot_quarantined"]
     summary["flow_degraded_trips"] = server.flow_counters()["degraded_trips"]
+    if infer_server is not None:
+        itm = infer_server.telemetry_summary()
+        summary["inference_requests"] = int(itm["inference/requests"])
+        summary["inference_sheds"] = int(itm["inference/sheds"])
+        summary["inference_compiled_buckets"] = int(
+            itm["inference/compiled_buckets"])
+        # the mode's whole point, as a ledger entry: actors pulled
+        # actions, not parameters (heartbeats aside, get_params should
+        # never fire once the plane is up)
+        with server.telemetry._lock:
+            summary["inference_param_pulls"] = int(
+                server.telemetry.method_calls.get("get_params", 0))
     summary["solver"] = solver
     summary["replay"] = replay
     return summary
@@ -1044,7 +1182,9 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
             seed=cfg.train.seed, use_native=cfg.replay.use_native)
     learn_start_seqs = max(cfg.replay.learn_start // seq_len, 2)
 
-    server, sup = _bring_up_rpc_plane(cfg, replay)
+    # no inference plane: recurrent actors carry per-episode LSTM state
+    # that cannot be microbatched across actors (BatchedPolicy rejects it)
+    server, sup, _ = _bring_up_rpc_plane(cfg, replay)
     server.publish_params(solver.get_weights())
 
     ckpt = maybe_checkpointer(cfg.train)

@@ -153,6 +153,23 @@ DEFAULT_REGISTRY = LockRegistry(
         "_drained_rows":    Guard("_cv", "IngestDrain"),
         "_drain_flushes":   Guard("_cv", "IngestDrain"),
         "_err":             Guard("_cv", "IngestDrain"),
+        # InferenceServer (ISSUE 9): the microbatch queue — pending
+        # request slots, the row gauge admission reads, and the shutdown
+        # flag — moves under one condition the batcher sleeps on.
+        # (_params_version is registered above for ReplayFeedServer;
+        # InferenceServer's copy under its own _params_lock follows the
+        # same discipline but the registry keys by attribute name)
+        "_pending":         Guard("_cv", "InferenceServer"),
+        "_queued_rows":     Guard("_cv", "InferenceServer"),
+        "_closed":          Guard("_cv", "InferenceServer"),
+        # InferenceTelemetry: every histogram/counter is touched from
+        # every serve thread plus the batcher; one lock guards them all
+        "requests":         Guard("_lock", "InferenceTelemetry"),
+        "sheds":            Guard("_lock", "InferenceTelemetry"),
+        "wire_errors":      Guard("_lock", "InferenceTelemetry"),
+        "latency_ms":       Guard("_lock", "InferenceTelemetry"),
+        "batch_rows":       Guard("_lock", "InferenceTelemetry"),
+        "forward_ms":       Guard("_lock", "InferenceTelemetry"),
         # NOTE deliberately unregistered: ReplayFeedServer.last_seen is a
         # GIL-atomic monotonic stamp dict (single-writer per key, reader
         # tolerates staleness); DeviceStager._err is benign once-set.
@@ -163,6 +180,7 @@ DEFAULT_REGISTRY = LockRegistry(
     files=(
         "distributed_deep_q_tpu/rpc/flowcontrol.py",
         "distributed_deep_q_tpu/rpc/replay_server.py",
+        "distributed_deep_q_tpu/rpc/inference_server.py",
         "distributed_deep_q_tpu/actors/supervisor.py",
         "distributed_deep_q_tpu/replay/staging.py",
         "distributed_deep_q_tpu/replay/columnar.py",

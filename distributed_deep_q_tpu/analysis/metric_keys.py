@@ -41,7 +41,7 @@ RULE_METRIC = "metric_keys.unknown-metric"
 RULE_SPAN = "metric_keys.unknown-span"
 
 NAMESPACES = ("rpc", "fleet", "queue", "durability", "flow", "trace",
-              "learner", "ingest")
+              "learner", "ingest", "inference")
 _NS_RE = re.compile(r"^(?:%s)/.+" % "|".join(NAMESPACES))
 
 EMITTERS = frozenset(
@@ -98,6 +98,16 @@ REGISTRY = frozenset({
     # columnar ingest plane (ISSUE 8): drain-thread throughput gauges
     "ingest/drained_rows",
     "ingest/drain_flushes",
+    # batched inference plane (ISSUE 9): histogram prefixes (summary
+    # suffixes expand at runtime) + request/shed/queue counters
+    "inference/latency_ms",
+    "inference/batch_rows",
+    "inference/forward_ms",
+    "inference/requests",
+    "inference/sheds",
+    "inference/wire_errors",
+    "inference/queued_rows",
+    "inference/compiled_buckets",
 })
 
 _TRACING_REL = os.path.join("distributed_deep_q_tpu", "tracing.py")

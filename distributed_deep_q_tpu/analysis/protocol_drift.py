@@ -6,8 +6,9 @@ silent ``{"error": "unknown method ..."}``) under traffic:
 
 - ``protocol.unhandled-method``: a ``.call("X")`` / ``.call_once("X")``
   anywhere in the package, ``scripts/``, or ``tests/`` whose method
-  string has no ``method == "X"`` branch in
-  ``ReplayFeedServer._dispatch``.
+  string has no ``method == "X"`` branch in any registered server
+  dispatch table (``SERVER_TABLES`` — the replay feed and the batched
+  inference plane).
 - ``protocol.orphan-handler``: a ``_dispatch`` branch whose method
   string no caller ever emits — dead protocol surface that drifts
   silently.
@@ -27,18 +28,25 @@ import os
 from distributed_deep_q_tpu.analysis.core import (
     Finding, Source, call_name, iter_py_files, load_sources)
 
-SERVER_FILE = "distributed_deep_q_tpu/rpc/replay_server.py"
+# every server-side dispatch table on the wire protocol: the replay
+# feed and (ISSUE 9) the batched inference plane. The two planes share
+# one client emit surface, so handlers are unioned before cross-checking
+SERVER_TABLES = (
+    ("distributed_deep_q_tpu/rpc/replay_server.py", "ReplayFeedServer"),
+    ("distributed_deep_q_tpu/rpc/inference_server.py", "InferenceServer"),
+)
 PROTOCOL_FILE = "distributed_deep_q_tpu/rpc/protocol.py"
 EMITTER_DIRS = ("distributed_deep_q_tpu", "scripts", "tests")
 
 
-def dispatch_handlers(server_src: Source) -> dict[str, int]:
-    """Method strings handled by ``ReplayFeedServer._dispatch``:
+def dispatch_handlers(server_src: Source,
+                      class_name: str = "ReplayFeedServer") -> dict[str, int]:
+    """Method strings handled by ``<class_name>._dispatch``:
     string constants compared against the ``method`` variable."""
     handlers: dict[str, int] = {}
     dispatch: ast.FunctionDef | None = None
     for node in ast.walk(server_src.tree):
-        if isinstance(node, ast.ClassDef) and node.name == "ReplayFeedServer":
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
             for item in ast.walk(node):
                 if isinstance(item, ast.FunctionDef) \
                         and item.name == "_dispatch":
@@ -115,31 +123,43 @@ def wire_kind_skew(proto_src: Source, out: list[Finding]) -> None:
                 "_decode", out)
 
 
-def check_sources(server_src: Source, proto_src: Source,
+def check_sources(server_src, proto_src: Source,
                   emitter_sources: list[Source]) -> list[Finding]:
+    """``server_src`` is one ``Source`` (checked as ``ReplayFeedServer``)
+    or a list of ``(Source, class_name)`` pairs — one per dispatch
+    table. Handlers are unioned for the unhandled-method direction; the
+    orphan direction attributes each handler to its own table."""
+    if isinstance(server_src, Source):
+        server_src = [(server_src, "ReplayFeedServer")]
     out: list[Finding] = []
-    handlers = dispatch_handlers(server_src)
+    tables = [(src, cls, dispatch_handlers(src, cls))
+              for src, cls in server_src]
+    handled = {m for _, _, handlers in tables for m in handlers}
     emitted = emitted_methods(emitter_sources)
     for method, src, line in emitted:
-        if method not in handlers:
+        if method not in handled:
             src.finding(
                 "protocol.unhandled-method", line,
-                f"client emits RPC method {method!r} but "
-                "ReplayFeedServer._dispatch has no handler for it", out)
+                f"client emits RPC method {method!r} but no server "
+                "_dispatch table has a handler for it "
+                f"({', '.join(cls for _, cls, _ in tables)})", out)
     emitted_names = {m for m, _, _ in emitted}
-    for method, line in sorted(handlers.items()):
-        if method not in emitted_names:
-            server_src.finding(
-                "protocol.orphan-handler", line,
-                f"_dispatch handles {method!r} but no client, script, or "
-                "test ever emits it", out)
+    for table_src, cls, handlers in tables:
+        for method, line in sorted(handlers.items()):
+            if method not in emitted_names:
+                table_src.finding(
+                    "protocol.orphan-handler", line,
+                    f"{cls}._dispatch handles {method!r} but no client, "
+                    "script, or test ever emits it", out)
     wire_kind_skew(proto_src, out)
     return out
 
 
 def check(repo_root: str) -> list[Finding]:
-    server_src = Source.load(os.path.join(repo_root, SERVER_FILE),
-                             SERVER_FILE)
+    server_srcs = [
+        (Source.load(os.path.join(repo_root, path), path), cls)
+        for path, cls in SERVER_TABLES
+        if os.path.exists(os.path.join(repo_root, path))]
     proto_src = Source.load(os.path.join(repo_root, PROTOCOL_FILE),
                             PROTOCOL_FILE)
     paths: list[str] = []
@@ -147,5 +167,5 @@ def check(repo_root: str) -> list[Finding]:
         full = os.path.join(repo_root, d)
         if os.path.isdir(full):
             paths.extend(iter_py_files(full))
-    return check_sources(server_src, proto_src,
+    return check_sources(server_srcs, proto_src,
                          load_sources(repo_root, sorted(set(paths))))

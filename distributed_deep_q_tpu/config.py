@@ -310,6 +310,43 @@ class TraceConfig:
 
 
 @dataclass
+class InferenceConfig:
+    """Batched inference plane (``rpc/inference_server.py``).
+
+    When enabled, the learner hosts an ``InferenceServer`` next to the
+    replay feed and actors ship OBSERVATIONS instead of pulling θ: the
+    server queues per-actor requests, cuts microbatches under the
+    deadline-aware SLO below, and answers with argmax actions + Q-values
+    from ONE device-resident forward. ε-greedy stays client-side
+    (seeded, per-actor ε) so exploration is bitwise reproducible either
+    way. Param pulls drop to zero in steady state and actor staleness
+    is eliminated by construction — the forward always uses the θ the
+    learner last pushed.
+    """
+
+    enabled: bool = False
+    # service address; port 0 = ephemeral (the supervisor rewrites the
+    # pickled cfg with the bound port before spawning actors). Snapshot
+    # runs that need a stable address set it explicitly
+    host: str = "127.0.0.1"
+    port: int = 0
+    # microbatch SLO: close a batch at max_batch rows OR cutoff_us after
+    # its first request, whichever comes first — the deadline bounds the
+    # tail latency a lone actor pays for batching
+    max_batch: int = 256
+    cutoff_us: int = 2000
+    # compiled batch buckets: each forward pads to the smallest bucket
+    # that fits, so XLA compiles at most len(buckets) programs (≤ 4 per
+    # the acceptance bound) instead of one per observed batch size
+    buckets: tuple = (8, 32, 128, 256)
+    # admission (reuses rpc/flowcontrol.py): queued rows beyond this shed
+    # new requests with an explicit retry_after_ms reply
+    queue_high_watermark: int = 4096
+    # reply-latency SLO for bench/chaos verdicts (not enforced inline)
+    slo_ms: float = 50.0
+
+
+@dataclass
 class Config:
     net: NetConfig = field(default_factory=NetConfig)
     replay: ReplayConfig = field(default_factory=ReplayConfig)
@@ -318,6 +355,7 @@ class Config:
     actors: ActorConfig = field(default_factory=ActorConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
+    inference: InferenceConfig = field(default_factory=InferenceConfig)
 
     def replace(self, **kv: Any) -> "Config":
         return dataclasses.replace(self, **kv)

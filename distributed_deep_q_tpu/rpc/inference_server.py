@@ -1,0 +1,444 @@
+"""Batched inference service — serve actions, not parameters (ISSUE 9).
+
+The parameter-pull topology ships N×θ bytes per sync and needs an
+explicit staleness throttle (``actors.max_param_lag``); the Podracer/
+Sebulba split (arXiv:2104.06272) and IMPACT (arXiv:1912.00167) invert
+it: the forward pass lives next to the learner on the accelerator and
+actors ship observations. This server is that inversion, riding the
+existing wire protocol unchanged (one new ``infer`` verb, v4 CRC
+framing, same faultinject chaos surface, same flowcontrol admission):
+
+- Serve threads (one per connection, the ``ReplayFeedServer`` shape)
+  enqueue ``infer`` requests and block on a per-request event
+  (``infer_wait`` span).
+- A single batcher thread cuts microbatches under a deadline-aware SLO:
+  a batch closes at ``max_batch`` queued rows OR ``cutoff_us`` after its
+  oldest request, whichever comes first — a lone actor pays at most the
+  cutoff, a busy fleet amortizes one forward across many actors.
+- The batch runs as ONE device-resident jitted forward
+  (``models/policy.py``, ``infer_forward`` span), padded to a fixed
+  bucket so XLA compiles at most ``len(buckets)`` programs.
+- Replies carry argmax actions + Q-value rows + the served θ version +
+  a flowcontrol credit grant. ε-greedy stays CLIENT-side (seeded,
+  per-actor ε) so exploration is bitwise reproducible.
+
+Admission reuses ``rpc/flowcontrol.py`` verbatim: the controller's
+"staged rows" gauge is the inference queue depth, its consumption EWMA
+is rows actually forwarded, and over-watermark requests get an explicit
+``shed`` reply with ``retry_after_ms`` — never a silent drop. An infer
+is a pure function of (θ, obs), so a client re-send after a shed or an
+ambiguous transport failure is naturally idempotent: no dedup map
+needed, the PR 2 zero-loss/zero-dup contract costs nothing here.
+
+θ installs are in-process (``set_params`` from the learner's publish
+cadence) — the wire never carries parameters on this plane, which is
+the point.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from distributed_deep_q_tpu import tracing
+from distributed_deep_q_tpu.metrics import Histogram
+from distributed_deep_q_tpu.rpc import faultinject
+from distributed_deep_q_tpu.rpc.flowcontrol import FlowConfig, FlowController
+from distributed_deep_q_tpu.rpc.protocol import (
+    ChecksumError, ProtocolError, recv_msg_sized, send_msg)
+from distributed_deep_q_tpu.rpc.replay_server import ReplayFeedClient
+
+log = logging.getLogger(__name__)
+
+# bound on one request's wait for its batch result: far above any sane
+# forward, low enough that a wedged device surfaces as shed replies the
+# client retries instead of serve threads parked forever
+REPLY_BOUND_S = 60.0
+
+
+class _QueueDepth:
+    """The flow controller's replay-shaped view of the inference queue:
+    admission reads pending ROWS through the same ``pending_rows``
+    surface the replay staging plane exposes, so ``FlowController``
+    needs no inference-specific branch."""
+
+    def __init__(self, server: "InferenceServer"):
+        self._server = server
+
+    def pending_rows(self) -> int:
+        return self._server.queued_rows()
+
+
+class _Pending:
+    """One queued infer request: observations in, a slot the batcher
+    fills, an event the serve thread blocks on."""
+
+    __slots__ = ("obs", "actor_id", "t_enq", "event", "actions", "q",
+                 "version", "error")
+
+    def __init__(self, obs: np.ndarray, actor_id: int):
+        self.obs = obs
+        self.actor_id = actor_id
+        self.t_enq = time.monotonic()
+        self.event = threading.Event()
+        self.actions: np.ndarray | None = None
+        self.q: np.ndarray | None = None
+        self.version = 0
+        self.error: str | None = None
+
+
+class InferenceTelemetry:
+    """One-lock inference-plane telemetry (the ``ServerTelemetry``
+    shape, scoped to this service): reply-latency / batch-size /
+    forward-time histograms plus request/shed/wire-error counters."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.latency_ms = Histogram()
+        self.batch_rows = Histogram()
+        self.forward_ms = Histogram()
+        self.requests = 0
+        self.sheds = 0
+        self.wire_errors = 0
+
+    def record_reply(self, ms: float) -> None:
+        with self._lock:
+            self.requests += 1
+            self.latency_ms.observe(ms)
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.sheds += 1
+
+    def record_wire_error(self) -> None:
+        with self._lock:
+            self.wire_errors += 1
+
+    def record_batch(self, rows: int, forward_ms: float) -> None:
+        with self._lock:
+            self.batch_rows.observe(float(rows))
+            self.forward_ms.observe(forward_ms)
+
+    def summary(self) -> dict[str, float]:
+        with self._lock:
+            out = {
+                "inference/requests": float(self.requests),
+                "inference/sheds": float(self.sheds),
+                "inference/wire_errors": float(self.wire_errors),
+            }
+            out.update(self.latency_ms.summary("inference/latency_ms"))
+            out.update(self.batch_rows.summary("inference/batch_rows"))
+            out.update(self.forward_ms.summary("inference/forward_ms"))
+            return out
+
+
+class InferenceServer:
+    """Microbatching action server over the v4 wire protocol.
+
+    ``policy`` is a ``models.policy.BatchedPolicy`` (owns the jitted
+    forward and the compiled-bucket census). One batcher thread, one
+    serve thread per connection, chaos-wrapped sockets, flowcontrol
+    admission — the same operational envelope as the replay feed.
+    """
+
+    def __init__(self, policy, host: str = "127.0.0.1", port: int = 0,
+                 max_batch: int = 256, cutoff_us: int = 2000,
+                 flow: FlowConfig | None = None):
+        self.policy = policy
+        self.max_batch = max(int(max_batch), 1)
+        self._cutoff_s = max(int(cutoff_us), 0) / 1e6
+        self.telemetry = InferenceTelemetry()
+        self.last_seen: dict[int, float] = {}
+        # request queue: pending list + row gauge + shutdown flag, all
+        # under one condition the batcher sleeps on
+        self._cv = threading.Condition()
+        self._pending: list[_Pending] = []
+        self._queued_rows = 0
+        self._closed = False
+        # θ install plane: version + the policy's parameter swap
+        self._params_lock = threading.Lock()
+        self._params_version = 0
+        # admission: the stock controller against the queue-depth proxy.
+        # Its lock is private to this plane (nothing shares state with
+        # the replay server), so a busy replay lock never delays an admit
+        self.flow = FlowController(flow or FlowConfig(),
+                                   threading.RLock(), _QueueDepth(self))
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._sock = socket.create_server((host, port))
+        self.address = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._batcher = threading.Thread(
+            target=self._batch_loop, name="infer-batch", daemon=True)
+        self._batcher.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="infer-accept", daemon=True)
+        self._accept_thread.start()
+
+    # -- learner-side API ---------------------------------------------------
+
+    def set_params(self, weights: list[np.ndarray],
+                   version: int | None = None) -> int:
+        """Install θ for the served forward (in-process push from the
+        learner's publish cadence — parameters never cross the wire on
+        this plane). Returns the installed version."""
+        with self._params_lock:
+            self.policy.set_weights(weights)
+            self._params_version = (int(version) if version is not None
+                                    else self._params_version + 1)
+            return self._params_version
+
+    def _published_version(self) -> int:
+        with self._params_lock:
+            return self._params_version
+
+    def queued_rows(self) -> int:
+        with self._cv:
+            return self._queued_rows
+
+    def telemetry_summary(self) -> dict[str, float]:
+        out = self.telemetry.summary()
+        out["inference/queued_rows"] = float(self.queued_rows())
+        out["inference/compiled_buckets"] = float(
+            len(self.policy.compiled_buckets()))
+        return out
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=5)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._batcher.join(timeout=5)
+        self.flow.close()
+
+    # -- wire loop ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        deadline = self.flow.cfg.conn_deadline_s
+        if deadline and deadline > 0:
+            conn.settimeout(deadline)
+        # the chaos shim applies to this socket exactly like the replay
+        # feed's — drop/delay/corrupt/stall verbs hit both planes
+        conn = faultinject.wrap(conn, side="server")
+        with self._conns_lock:
+            self._conns.add(conn)
+        try:
+            while not self._stop.is_set():
+                try:
+                    req, _ = recv_msg_sized(conn)
+                except TimeoutError:
+                    return  # idle past the conn deadline; client reconnects
+                except (ChecksumError, ProtocolError) as e:
+                    # corrupt/desynced stream: no reply possible — drop
+                    # the conn; an infer re-send is naturally idempotent
+                    self.telemetry.record_wire_error()
+                    log.warning("inference bad frame: %s: %s",
+                                type(e).__name__, e)
+                    return
+                try:
+                    resp = self._dispatch(req)
+                except Exception as e:  # noqa: BLE001 — malformed payloads
+                    # must answer loudly, never kill the serve thread
+                    log.warning("inference dispatch %r: %s: %s",
+                                req.get("method"), type(e).__name__, e)
+                    resp = {"error": f"{type(e).__name__}: {e}"}
+                send_msg(conn, resp)
+        except TimeoutError:
+            pass  # deadline expired mid-send
+        except (ConnectionError, OSError):
+            pass  # client went away; its supervisor owns liveness
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            conn.close()
+
+    def _dispatch(self, req: dict[str, Any]) -> dict[str, Any]:
+        method = req.get("method")
+        actor_id = int(req.get("actor_id", -1))
+        if actor_id >= 0:
+            self.last_seen[actor_id] = time.monotonic()
+
+        if method == "infer":
+            with tracing.activate(req):
+                return self._infer(req, actor_id)
+
+        if method == "heartbeat":
+            return {"ok": True}
+
+        if method == "stats":
+            out: dict[str, Any] = {
+                "params_version": self._published_version(),
+                "compiled_buckets": np.asarray(
+                    self.policy.compiled_buckets(), np.int64),
+            }
+            out.update(self.telemetry_summary())
+            return out
+
+        return {"error": f"unknown method {method!r}"}
+
+    # -- the infer verb ------------------------------------------------------
+
+    def _infer(self, req: dict[str, Any], actor_id: int) -> dict[str, Any]:
+        t0 = time.perf_counter()
+        obs = np.asarray(req["obs"])
+        if obs.ndim < 2:
+            return {"error": "infer obs must be a stacked [n, ...] batch"}
+        n = int(obs.shape[0])
+        admitted, retry_ms = self.flow.admit(actor_id, n)
+        if not admitted:
+            # explicit shed, never a silent drop: the client re-sends the
+            # SAME observations after retry_after_ms; the infer is a pure
+            # function of (θ, obs), so the re-send is idempotent for free
+            self.telemetry.record_shed()
+            return {"shed": True, "retry_after_ms": retry_ms,
+                    "credits": self.flow.grant(actor_id)}
+        self.flow.on_ingest(actor_id, n)
+        p = _Pending(obs, actor_id)
+        with self._cv:
+            if self._closed:
+                return {"error": "inference server closing"}
+            self._pending.append(p)
+            self._queued_rows += n
+            self._cv.notify_all()
+        with tracing.span("infer_wait"):
+            if not p.event.wait(REPLY_BOUND_S):
+                timed_out = False
+                with self._cv:
+                    if p in self._pending:
+                        # never picked up (wedged batcher/device): shed it
+                        # so the client retries instead of hanging
+                        self._pending.remove(p)
+                        self._queued_rows -= n
+                        timed_out = True
+                # grant OUTSIDE _cv: admit holds the flow lock while it
+                # reads queue depth under _cv — grant-under-_cv would be
+                # the reverse order (deadlock)
+                if timed_out:
+                    self.telemetry.record_shed()
+                    return {"shed": True, "retry_after_ms": 1000,
+                            "credits": self.flow.grant(actor_id)}
+                p.event.wait()  # in-flight: the forward owns it now
+        if p.error is not None:
+            return {"error": p.error}
+        resp: dict[str, Any] = {
+            "actions": p.actions,
+            "q": p.q,
+            "version": p.version,
+            "credits": self.flow.grant(actor_id),
+        }
+        if "seq" in req:
+            resp["seq"] = req["seq"]  # client-side pairing check
+        self.telemetry.record_reply(1e3 * (time.perf_counter() - t0))
+        return resp
+
+    # -- the batcher ---------------------------------------------------------
+
+    def _take_batch(self) -> list[_Pending]:
+        """Block until a microbatch is due, pop it. Empty ⇒ shutting down.
+
+        A batch closes at ``max_batch`` queued rows or ``cutoff_us``
+        after its OLDEST request — the deadline bounds the tail latency
+        a lone actor pays for batching. Whole requests only: one reply
+        per request, rows never split across forwards."""
+        with self._cv:
+            while not self._pending and not self._closed:
+                self._cv.wait(0.25)
+            if not self._pending:
+                return []  # closed and drained
+            deadline = self._pending[0].t_enq + self._cutoff_s
+            while self._queued_rows < self.max_batch and not self._closed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            take: list[_Pending] = []
+            rows = 0
+            while self._pending and rows < self.max_batch:
+                nxt = self._pending[0].obs.shape[0]
+                if take and rows + nxt > self.max_batch:
+                    break  # oversized single requests still go alone
+                take.append(self._pending.pop(0))
+                rows += nxt
+            self._queued_rows -= rows
+            return take
+
+    def _batch_loop(self) -> None:
+        while True:
+            take = self._take_batch()
+            if not take:
+                return
+            self._run_batch(take)
+
+    def _run_batch(self, take: list[_Pending]) -> None:
+        with tracing.span("infer_batch"):
+            obs = (take[0].obs if len(take) == 1
+                   else np.concatenate([p.obs for p in take]))
+            version = self._published_version()
+        rows = int(obs.shape[0])
+        t0 = time.perf_counter()
+        try:
+            with tracing.span("infer_forward"):
+                actions, q = self.policy.forward(obs)
+        except Exception as e:  # noqa: BLE001 — a failed forward must
+            # release every waiter with a loud error, not park them
+            log.warning("inference forward failed: %s: %s",
+                        type(e).__name__, e)
+            for p in take:
+                p.error = f"{type(e).__name__}: {e}"
+                p.event.set()
+            return
+        self.telemetry.record_batch(rows, 1e3 * (time.perf_counter() - t0))
+        self.flow.note_consumed(rows)
+        off = 0
+        for p in take:
+            k = p.obs.shape[0]
+            p.actions = actions[off:off + k]
+            p.q = q[off:off + k]
+            p.version = version
+            off += k
+            p.event.set()
+
+
+class InferenceClient(ReplayFeedClient):
+    """Actor-side stub for the inference plane: the ``ReplayFeedClient``
+    transport (one persistent chaos-wrapped connection, lazy reconnect
+    after any failure) pointed at an ``InferenceServer``, plus the one
+    helper this plane adds. The replay-specific helpers it inherits are
+    meaningless against this server and go unused."""
+
+    def infer(self, obs: np.ndarray, seq: int = -1) -> dict[str, Any]:
+        """One infer round trip for a stacked [n, ...] observation batch.
+        Returns the raw reply dict (``actions``/``q``/``version`` or
+        ``shed``/``retry_after_ms``); callers own retry and shed policy."""
+        return self.call("infer", obs=np.ascontiguousarray(obs), seq=seq)

@@ -70,6 +70,10 @@ STAGES = (
     "device_put",      # host→device transfer of a sampled batch
     "train_step",      # train-step dispatch (fused chain or per-step)
     "param_pull",      # actor get_params round trip
+    "infer_wait",      # inference serve thread waiting on its microbatch
+    "infer_batch",     # microbatch cut: stack + pad to a compiled bucket
+    "infer_forward",   # the ONE device-resident jit'd policy forward
+    "remote_infer",    # actor-side infer round trip (obs out, action back)
     "snapshot_capture",  # durability: state capture under locks
     "snapshot_write",  # durability: serialize + atomic write (off-lock)
     "restore",         # durability: warm-boot generation walk

@@ -43,10 +43,15 @@ _LOWER_BETTER = ("_ms", "_fusions", "_convs", "_copies", "fusions",
                  "spread")
 # keys that are configuration echoes / identities, not metrics
 # (max_in_flight_rows is the writers' backpressure watermark — a state
-# echo of the pacing loop, not a quality axis with a bad direction)
+# echo of the pacing loop, not a quality axis with a bad direction;
+# inference_curve's SLO/batch knobs are config echoes, sheds a state
+# echo, and local_actions_per_s the comparison-host baseline the
+# speedup already folds in — gating it would gate host CPU noise)
 _SKIP = ("_chain_k", "_vs_", "vs_baseline", "ring_capacity",
          "flagship_batch", "concurrent_writers", "peak_flops", "n", "rc",
-         "flops_per_step", "max_in_flight_rows")
+         "flops_per_step", "max_in_flight_rows", "inference_slo_ms",
+         "inference_max_batch", "inference_cutoff_us", "sheds",
+         "local_actions_per_s")
 
 
 def _parsed(path: str) -> dict:
@@ -74,8 +79,9 @@ def _spread_for(key: str, a: dict, b: dict) -> float | None:
 
 
 def _flatten(d: dict, prefix: str = "") -> dict:
-    """ingest_curve-style nests become dotted keys; each nested dict's
-    own ``spread`` rides along under its dotted name."""
+    """Nested curve rows (``ingest_curve``, ``inference_curve``) become
+    dotted keys; each nested dict's own ``spread`` rides along under its
+    dotted name and becomes the tolerance for its siblings."""
     out = {}
     for k, v in d.items():
         key = f"{prefix}{k}"

@@ -1,6 +1,6 @@
 """Chaos smoke — prove the RPC fault-tolerance stack end to end.
 
-Five modes:
+Six modes:
 
 ``python scripts/chaos_smoke.py [num_actors] [spec]`` (default)
     Threaded actor fleet over the production wire protocol: resilient
@@ -32,6 +32,16 @@ Five modes:
     fired, the drain (not the writers) carried the flushes, and every
     frame landed in the HBM ring exactly once (ids decoded back out of
     the ring rows).
+
+``python scripts/chaos_smoke.py inference [spec]``
+    Inference-plane acceptance (ISSUE 9): a client fleet streams
+    deterministic labeled observations at an ``InferenceServer`` while
+    the chaos shim drops, truncates, delays, and bit-flips connections.
+    Every reply's action is checked against the local argmax of the SAME
+    θ for that exact observation — the gate is zero wrong, zero missing,
+    zero duplicated actions despite reconnects and shed/retry cycles
+    (``infer`` is pure in (θ, obs), so retries need no dedup; a wrong
+    action would mean a slicing/padding/batching bug under fault load).
 
 ``python scripts/chaos_smoke.py durability [cycles] [spec]``
     Crash-recovery acceptance (ISSUE 6): the server is hard-killed at
@@ -455,6 +465,160 @@ def run_ingest_saturation_smoke(num_actors: int = 3, flushes: int = 40,
     return verdict
 
 
+def run_inference_chaos_smoke(
+        num_clients: int = 4, requests: int = 100,
+        spec: str = "drop=0.03,truncate=0.02,corrupt=0.01,seed=29",
+        deadline: float = 120.0) -> dict:
+    """Remote-inference fleet under wire chaos: every action must be
+    RIGHT, not just delivered.
+
+    Each client sends labeled single-row observations (deterministic in
+    ``(client, i)``) through the resilient retry idiom — reconnect on
+    transport failure, back off on shed — and records the action the
+    server returned. The oracle is a second ``BatchedPolicy`` built from
+    the same seed with bucket (1,): the canonical per-actor CPU forward
+    the remote plane replaces. Zero mismatches proves the microbatcher's
+    pad/slice/concat machinery never crossed wires between concurrent
+    clients, even while chaos forced partial batches and re-sends."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_deep_q_tpu.config import InferenceConfig, NetConfig
+    from distributed_deep_q_tpu.models.policy import BatchedPolicy
+    from distributed_deep_q_tpu.rpc import faultinject
+    from distributed_deep_q_tpu.rpc.flowcontrol import FlowConfig
+    from distributed_deep_q_tpu.rpc.inference_server import (
+        InferenceClient, InferenceServer)
+
+    trc = _trace_begin()
+    plan = faultinject.install(spec) if spec else None
+    obs_dim = 8
+    icfg = InferenceConfig()
+    net = NetConfig(kind="mlp", hidden=(32, 32), num_actions=4)
+    policy = BatchedPolicy(net, seed=7, obs_dim=obs_dim,
+                           buckets=icfg.buckets)
+    server = InferenceServer(policy, max_batch=icfg.max_batch,
+                             cutoff_us=icfg.cutoff_us,
+                             flow=FlowConfig(flush_credit_floor=8))
+    host, port = server.address
+
+    def make_obs(aid: int, i: int) -> np.ndarray:
+        # labeled: the observation IS the identity — a unique
+        # deterministic vector per (client, request)
+        r = np.random.default_rng(1_000 * (aid + 1) + i)
+        return r.standard_normal(obs_dim).astype(np.float32)
+
+    errors: list[str] = []
+    sheds = [0] * num_clients
+    got: list[dict[int, int]] = [{} for _ in range(num_clients)]
+
+    def client(aid: int) -> None:
+        c = None
+        try:
+            for i in range(requests):
+                obs = make_obs(aid, i)[None]
+                for _ in range(400):
+                    try:
+                        if c is None:
+                            c = InferenceClient(host, port, actor_id=aid,
+                                                timeout=5.0)
+                        resp = c.call("infer", obs=obs, seq=i)
+                    except Exception:  # noqa: BLE001 — chaos; reconnect
+                        try:
+                            if c is not None:
+                                c.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                        c = None
+                        time.sleep(0.005)
+                        continue
+                    if resp.get("error"):
+                        time.sleep(0.005)
+                        continue
+                    if resp.get("shed"):
+                        sheds[aid] += 1
+                        trc.instant("shed", plane="inference")
+                        time.sleep(
+                            max(resp.get("retry_after_ms", 10), 1) / 1e3)
+                        continue
+                    # infer is idempotent in (θ, obs): a retried request
+                    # may land twice server-side, but the client keeps
+                    # exactly one action per i — overwrite would only
+                    # matter if replies disagreed, which mismatch catches
+                    if i in got[aid]:
+                        errors.append(f"client {aid}: duplicate reply "
+                                      f"recorded for request {i}")
+                    got[aid][i] = int(np.asarray(resp["actions"])[0])
+                    break
+                else:
+                    errors.append(
+                        f"client {aid}: request {i} never landed")
+                    return
+        except Exception as e:  # noqa: BLE001 — reported in the verdict
+            errors.append(f"client {aid}: {type(e).__name__}: {e}")
+        finally:
+            try:
+                if c is not None:
+                    c.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    threads = [threading.Thread(target=client, args=(a,), daemon=True)
+               for a in range(num_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=deadline)
+    hung = sum(t.is_alive() for t in threads)
+    wall = time.perf_counter() - t0
+    tm = server.telemetry_summary()
+    server.close()
+    if plan:
+        faultinject.uninstall()
+
+    # oracle AFTER the run so its forwards never interleave with the
+    # server's batcher on the same jit cache mid-chaos
+    oracle = BatchedPolicy(net, seed=7, obs_dim=obs_dim, buckets=(1,))
+    wrong = missing = 0
+    for aid in range(num_clients):
+        for i in range(requests):
+            if i not in got[aid]:
+                missing += 1
+                continue
+            want, _ = oracle.forward(make_obs(aid, i)[None])
+            if got[aid][i] != int(want[0]):
+                wrong += 1
+    total_sheds = sum(sheds)
+    verdict = {
+        "ok": (not errors and not hung and wrong == 0 and missing == 0),
+        "num_clients": num_clients,
+        "requests_sent": num_clients * requests,
+        "replies": sum(len(g) for g in got),
+        "wrong_actions": wrong,
+        "missing_actions": missing,
+        "client_sheds": total_sheds,
+        "server_requests": tm.get("inference/requests", 0),
+        "server_sheds": tm.get("inference/sheds", 0),
+        "server_wire_errors": tm.get("inference/wire_errors", 0),
+        "compiled_buckets": tm.get("inference/compiled_buckets", 0),
+        "chaos_spec": spec,
+        "faults_fired": dict(sorted(plan.counters.items())) if plan else {},
+        "hung_clients": hung,
+        "errors": errors,
+        "wall_s": round(wall, 2),
+    }
+    trace = _trace_verdict(trc)
+    verdict["trace"] = trace
+    # shed/retry cycles must be VISIBLE as instants, and faults must not
+    # orphan the infer_wait/infer_batch/infer_forward span tree
+    verdict["ok"] = (verdict["ok"] and trace["orphan_spans"] == 0
+                     and (total_sheds == 0
+                          or trace["instants"].get("shed", 0) > 0))
+    return verdict
+
+
 def run_durability_smoke(cycles: int = 20, num_actors: int = 3,
                          flushes_per_cycle: int = 4, rows: int = 8,
                          spec: str = "torn=0.35,corrupt=0.03,seed=23",
@@ -676,6 +840,12 @@ if __name__ == "__main__":
         if len(args) > 2:
             kwargs["spec"] = args[2]
         verdict = run_durability_smoke(**kwargs)
+        print(json.dumps(verdict))
+        sys.exit(0 if verdict["ok"] else 1)
+    if args and args[0] in ("inference", "--inference"):
+        verdict = run_inference_chaos_smoke(
+            spec=args[1] if len(args) > 1
+            else "drop=0.03,truncate=0.02,corrupt=0.01,seed=29")
         print(json.dumps(verdict))
         sys.exit(0 if verdict["ok"] else 1)
     if args and args[0] in ("ingest", "--ingest", "saturation"):
