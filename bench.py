@@ -929,6 +929,91 @@ def trace_ingest(cfg_mod, on_cpu: bool) -> None:
     }))
 
 
+MULTIHOST_HOSTS = (1, 2, 4)
+MULTIHOST_INGEST_TARGET = 16_384  # global t/s target, split across hosts
+
+
+def _multihost_curve(note) -> dict:
+    """Spawn ``scripts/_bench_multihost_worker.py`` at 1/2/4 simulated
+    hosts and aggregate each point (see the worker's docstring for the
+    measurement design). Rates/spread come from host 0 — lockstep
+    dispatch makes every host's window the same wall interval — while
+    ingest and the cross-host-RPC ledger sum over all hosts. Workers run
+    WITHOUT the persistent compile cache: deserialized executables
+    segfault in the gloo collectives on the multi-process CPU backend.
+    """
+    import os
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "_bench_multihost_worker.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    curve: dict = {}
+    for n in MULTIHOST_HOSTS:
+        with socket.socket() as s:  # free coordinator port
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        tmp = tempfile.mkdtemp(prefix=f"mh{n}_")
+        outs = [os.path.join(tmp, f"host{pid}.json") for pid in range(n)]
+        # stderr to files, not pipes: a worker stuck in a collective must
+        # not also wedge a sibling blocked writing to a full stderr pipe
+        errp = [os.path.join(tmp, f"host{pid}.stderr") for pid in range(n)]
+        err_fhs = [open(e, "wb") for e in errp]
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(pid), str(n), str(port),
+             outs[pid], str(MULTIHOST_INGEST_TARGET)],
+            env=env, stdout=subprocess.DEVNULL, stderr=err_fhs[pid])
+            for pid in range(n)]
+        try:
+            for p in procs:
+                p.wait(timeout=900)
+        finally:
+            for p in procs:  # one hung collective must not leak the rest
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+            for fh in err_fhs:
+                fh.close()
+        for pid, p in enumerate(procs):
+            if p.returncode != 0:
+                with open(errp[pid], "rb") as fh:
+                    err = fh.read()
+                raise RuntimeError(
+                    f"multihost worker {pid}/{n} rc={p.returncode}:\n"
+                    + err.decode(errors="replace")[-2000:])
+        hosts = []
+        for o in outs:
+            with open(o) as fh:
+                hosts.append(json.load(fh))
+        for h in hosts:
+            if h.get("writer_errors"):
+                raise RuntimeError(
+                    f"multihost n={n}: host {h['pid']} writer thread "
+                    f"died mid-run: {h['writer_errors']}")
+        rates = hosts[0]["rates"]
+        wall = float(np.median(rates))
+        point = {
+            "n_hosts": n,
+            # AGGREGATE plane throughput — the headline (see above)
+            "steps_per_s": round(wall * n, 2),
+            "wall_steps_per_s": round(wall, 2),
+            "spread": round((max(rates) - min(rates)) / wall, 4),
+            "ingest_t_per_s": round(sum(h["ingest_t_per_s"]
+                                        for h in hosts), 1),
+            "cross_host_replay_rpcs": sum(h["foreign_actor_calls"]
+                                          for h in hosts),
+            "dispatch_k": hosts[0]["dispatch_k"],
+        }
+        note(f"multihost n={n}: {point['steps_per_s']} agg steps/s "
+             f"(wall {point['wall_steps_per_s']}, "
+             f"spread {point['spread']})")
+        curve[str(n)] = point
+    return curve
+
+
 def main() -> None:
     import jax
 
@@ -983,7 +1068,14 @@ def main() -> None:
     probe = replay.sample(BATCH)
     probe.pop("_sampled_at", None)
     out["fence_rtt_ms"] = round(1e3 * _fence_rtt(solver), 2)
-    rates = time_variant(solver, replay, BATCH, iters // 2, warmup)
+    # settled-window warmup (ISSUE 10 satellite): idle_uniform has no
+    # writer ramp, but the runtime's dispatch queue + allocator still
+    # warm in over the first seconds — the same transient PR 9 fenced
+    # out of the under-ingest variants. With it, the idle spread drops
+    # under the gate threshold and the key graduates out of the
+    # tunnel-bound annotate-only set below.
+    rates = time_variant(solver, replay, BATCH, iters // 2, warmup,
+                         settle_s=1.0 if on_cpu else 3.0)
     idle = float(np.median(rates))
     out["idle_uniform_steps_per_s"] = round(idle, 2)
     out["idle_spread"] = round((max(rates) - min(rates)) / idle, 4)
@@ -1171,6 +1263,34 @@ def main() -> None:
     out["prioritized"] = True
     out["flagship_per"] = "device_fused"  # replay/device_per.py
     out["concurrent_writers"] = writers
+    del solver, replay
+
+    note("multihost_curve")
+    # -- multihost_curve (ISSUE 10 tentpole) ------------------------------
+    # N simulated learner hosts, each a separate OS process owning a FULL
+    # local data plane (replay shard, feed server, hash-assigned writers,
+    # shard-local PER, per-shard priority write-back); the single
+    # cross-host sync is the in-step pmean. The workload is fixed
+    # GLOBALLY (strong scaling), so on this time-sliced container the
+    # honest headline per point is the AGGREGATE plane throughput
+    # (wall steps/s × n_hosts) — linear in N iff the sharing overhead
+    # stays small; wall rate is recorded alongside. On a real pod each
+    # host has its own chips and the WALL rate itself holds ~flat.
+    # ``cross_host_replay_rpcs`` is ledger evidence: every feed server
+    # reports the actor ids it served; any id outside the host's
+    # hash-assigned slice would count here. Gate: 0.
+    mh = _multihost_curve(note)
+    out["multihost_curve"] = mh
+    base = mh["1"]["steps_per_s"]
+    out["multihost_linearity_2x"] = round(mh["2"]["steps_per_s"] / base, 2)
+    out["multihost_linearity_4x"] = round(mh["4"]["steps_per_s"] / base, 2)
+    # a ratio's run-to-run spread is (to first order) the sum of its two
+    # points' spreads — recorded so bench_diff gates the ratio against
+    # its own measured noise instead of the default tolerance
+    out["multihost_linearity_2x_spread"] = round(
+        mh["1"]["spread"] + mh["2"]["spread"], 4)
+    out["multihost_linearity_4x_spread"] = round(
+        mh["1"]["spread"] + mh["4"]["spread"], 4)
 
     # -- derived ----------------------------------------------------------
     # spread discipline (VERDICT r4 next #5): chained keys must hold
@@ -1185,11 +1305,21 @@ def main() -> None:
     # the columnar stage + batched drain the curve's steps_per_s track
     # the chained learner (spread recorded per point), so bench_diff
     # gates them like any other row instead of annotate-only.
-    out["tunnel_bound_keys"] = [
-        "idle_uniform_steps_per_s", "pallas_on_steps_per_s",
-        "pallas_off_steps_per_s", "batch32_single_dispatch_steps_per_s",
-        "r2d2_host_steps_per_s", "r2d2_device_steps_per_s",
-        "flagship_under_ingest_steps_per_s"]
+    # Promotion is now MEASURED per run (ISSUE 10 satellite): a key whose
+    # settled-window spread came in at/under the 0.05 gate threshold this
+    # run is gate-stable and leaves the annotate-only set; a noisy run
+    # keeps it annotated, so the demotion is honest rather than sticky.
+    tunnel = ["pallas_on_steps_per_s",
+              "batch32_single_dispatch_steps_per_s",
+              "r2d2_host_steps_per_s", "r2d2_device_steps_per_s"]
+    if out["idle_spread"] > 0.05:
+        # idle_uniform and pallas_off time the SAME uniform-ring step
+        # program (pallas only changes the PER gather), so one settled
+        # spread speaks for both
+        tunnel += ["idle_uniform_steps_per_s", "pallas_off_steps_per_s"]
+    if out["under_ingest_spread"] > 0.05:
+        tunnel.append("flagship_under_ingest_steps_per_s")
+    out["tunnel_bound_keys"] = sorted(tunnel)
     dev = jax.devices()[0]
     peak = peak_flops_for(dev)
     out["device_kind"] = getattr(dev, "device_kind", dev.platform)

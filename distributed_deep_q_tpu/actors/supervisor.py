@@ -110,9 +110,23 @@ def _split_fleet_across_processes(cfg: Config, pixel: bool, metrics,
                 "(per-host host-RAM shards feeding global_batch)")
         local_batch = cfg.replay.batch_size // pc
         k = cfg.actors.num_actors // pc
-        cfg = cfg.replace(actors=dataclasses.replace(
-            cfg.actors, num_actors=k, actor_id_offset=pid * k,
-            fleet_size=cfg.actors.num_actors))
+        if cfg.actors.assignment == "hash":
+            # consistent-hash placement (actors/assignment.py): each host
+            # owns the gids the bounded-load ring assigns its TOKEN, so a
+            # restarting actor keeps its host, host join/leave remaps only
+            # ~fleet/pc actors, and an address change is just a reconnect.
+            # Fleet % pc == 0 (checked above) makes the slices exactly k
+            # long, so per-host replay geometry stays uniform.
+            from distributed_deep_q_tpu.actors.assignment import local_slice
+            gids = local_slice(cfg.actors.num_actors, pc, pid)
+            cfg = cfg.replace(actors=dataclasses.replace(
+                cfg.actors, num_actors=k, actor_id_offset=0,
+                actor_gids=tuple(gids),
+                fleet_size=cfg.actors.num_actors))
+        else:
+            cfg = cfg.replace(actors=dataclasses.replace(
+                cfg.actors, num_actors=k, actor_id_offset=pid * k,
+                fleet_size=cfg.actors.num_actors))
         if pid != 0:
             metrics = Metrics()
     return cfg, local_batch, metrics, pc, pid
@@ -391,8 +405,11 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
     from distributed_deep_q_tpu.config import env_for_actor
     # global identity: actor_id is the LOCAL id (= per-host replay stream);
     # seeding and the ε ladder use the fleet-global id so multi-host slices
-    # decorrelate instead of repeating each other (config 5 full shape)
-    gid = actor_id + cfg.actors.actor_id_offset
+    # decorrelate instead of repeating each other (config 5 full shape).
+    # Under assignment="hash" the supervisor hands each host an explicit
+    # gid slice (actors/assignment.py) instead of a contiguous offset
+    gid = (cfg.actors.actor_gids[actor_id] if cfg.actors.actor_gids
+           else actor_id + cfg.actors.actor_id_offset)
     fleet = cfg.actors.fleet_size or cfg.actors.num_actors
     env = StepLatencyEnv(make_env(env_for_actor(cfg.env, gid),
                                   seed=cfg.train.seed + 1000 * (gid + 1)))

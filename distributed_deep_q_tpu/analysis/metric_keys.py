@@ -41,7 +41,7 @@ RULE_METRIC = "metric_keys.unknown-metric"
 RULE_SPAN = "metric_keys.unknown-span"
 
 NAMESPACES = ("rpc", "fleet", "queue", "durability", "flow", "trace",
-              "learner", "ingest", "inference")
+              "learner", "ingest", "inference", "shard")
 _NS_RE = re.compile(r"^(?:%s)/.+" % "|".join(NAMESPACES))
 
 EMITTERS = frozenset(
@@ -57,7 +57,9 @@ REGISTRY = frozenset({
     "env_steps",
     "grad_steps",
     # rpc server telemetry (scalar keys; per-method f-string keys are
-    # dynamic and unchecked)
+    # dynamic and unchecked — except names a reader spells out as a
+    # literal, which are declared so the read side stays registered)
+    "rpc/add_transitions_calls",
     "rpc/checksum_errors",
     "rpc/conn_timeouts",
     "rpc/dispatch_errors",
@@ -108,6 +110,12 @@ REGISTRY = frozenset({
     "inference/wire_errors",
     "inference/queued_rows",
     "inference/compiled_buckets",
+    # multi-host sharded replay (ISSUE 10): per-shard data-plane gauges
+    # — each learner process's server IS one shard, so these read as
+    # shard fill / shard-local ingest rate / owning process index
+    "shard/rows",
+    "shard/ingest_rate",
+    "shard/owner_host",
 })
 
 _TRACING_REL = os.path.join("distributed_deep_q_tpu", "tracing.py")

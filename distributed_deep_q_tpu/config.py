@@ -210,6 +210,16 @@ class ActorConfig:
     # segments instead of repeating the same one
     actor_id_offset: int = 0
     fleet_size: int = 0  # 0 = num_actors (single-host)
+    # actor→host placement for multi-host fleets (actors/assignment.py):
+    # "contiguous" slices the gid range per process (the historical
+    # layout); "hash" walks a bounded-load consistent-hash ring, so a
+    # restarting actor keeps its host, host join/leave remaps only
+    # ~fleet/hosts actors, and a host address change is just a reconnect
+    assignment: str = "contiguous"
+    # explicit local→global actor id map, filled in by the supervisor's
+    # fleet split under assignment="hash" (local slot i plays global
+    # actor actor_gids[i]). Empty = derive gid as actor_id + offset
+    actor_gids: tuple[int, ...] = ()
     # Ape-X ε ladder: actor i uses ε = base ** (1 + i/(N-1) * alpha) [T]
     eps_base: float = 0.4
     eps_alpha: float = 7.0

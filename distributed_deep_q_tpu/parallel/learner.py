@@ -38,7 +38,8 @@ from distributed_deep_q_tpu.config import TrainConfig
 from distributed_deep_q_tpu.models.qnet import (
     stacked_q_apply, stacked_q_forwards)
 from distributed_deep_q_tpu.ops.losses import bellman_targets, dqn_loss
-from distributed_deep_q_tpu.parallel.mesh import AXIS_DP
+from distributed_deep_q_tpu.parallel.mesh import (
+    AXIS_DP, AXIS_MODEL, tree_shardings)
 from distributed_deep_q_tpu.parallel.multihost import (
     global_batch, put_replicated)
 
@@ -423,14 +424,21 @@ class Learner:
     # -- state -------------------------------------------------------------
 
     def init_state(self, params: Any) -> TrainState:
-        """Build a fully-replicated TrainState on the mesh."""
+        """Build the TrainState on the mesh. With ``model=1`` (every
+        current config) everything replicates — the historical, bitwise
+        path. A real model axis places each leaf by the declarative
+        partition rules instead (``parallel.mesh.DEFAULT_PARTITION_RULES``,
+        ISSUE 10): optimizer moments inherit their parameter's spec
+        because the rules match tree paths, not leaf names."""
         state = TrainState(
             params=params,
             target_params=jax.tree.map(jnp.copy, params),
             opt_state=self.opt.init(params),
             step=jnp.zeros((), jnp.int32),
         )
-        return put_replicated(state, self._replicated)
+        if self.mesh.shape[AXIS_MODEL] <= 1:
+            return put_replicated(state, self._replicated)
+        return put_replicated(state, tree_shardings(self.mesh, state))
 
     # -- train step --------------------------------------------------------
 
@@ -633,7 +641,12 @@ class Learner:
         use_stacked = (cfg.stack_forwards == "on"
                        or (cfg.stack_forwards == "auto"
                            and per_shard <= 128))
-        use_plane = use_stacked and cfg.optimizer == "adam"
+        # the flat plane-carry layout concatenates every leaf into one
+        # replicated f32 plane, which is incompatible with per-leaf
+        # model-axis partition rules (parallel.mesh) — a real model axis
+        # keeps the per-leaf tree path where rule shardings apply
+        use_plane = (use_stacked and cfg.optimizer == "adam"
+                     and self.mesh.shape[AXIS_MODEL] <= 1)
 
         def unpack_batch(batch, w):
             batch = dict(batch)

@@ -13,14 +13,84 @@ SURVEY §2.2 records TP/PP as deliberately out of scope).
 
 from __future__ import annotations
 
+import re
+
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_deep_q_tpu.config import MeshConfig
 
 AXIS_DP = "dp"
 AXIS_MODEL = "model"
+
+# -- declarative partition rules (ISSUE 10; SNIPPETS.md [2][3] idiom) ------
+#
+# regex → PartitionSpec, matched with ``re.search`` against the
+# '/'-joined path of every leaf in a pytree. First match wins; scalars
+# short-circuit to replicated; the final catch-all means resolution
+# never fails. Today every config runs ``model=1`` so all of these
+# BEHAVE replicated — the rules are the declarative seam that lets a
+# torso grow past replicated without touching the learner: widen the
+# net, raise ``mesh.model``, and the same table shards it.
+#
+# Matching the leaf PATH (not just the leaf name) means the rules
+# resolve identically for ``params/Conv_0/kernel`` and its optimizer
+# mirrors ``opt_state/.../mu/Conv_0/kernel`` — moments inherit their
+# parameter's spec for free.
+DEFAULT_PARTITION_RULES: tuple[tuple[str, P], ...] = (
+    # torso conv kernels [H, W, Cin, Cout]: shard output features
+    (r"torso/conv\d+/kernel$", P(None, None, None, AXIS_MODEL)),
+    # torso dense kernels [in, out]: shard output features
+    (r"torso/fc\d+/kernel$", P(None, AXIS_MODEL)),
+    # per-output-feature vectors ride with their kernel's output shard
+    (r"torso/(conv|fc)\d+/bias$", P(AXIS_MODEL)),
+    # heads (q/value/advantage — num_actions wide, tiny), the LSTM, and
+    # every scalar stay replicated
+    (r".*", P()),
+)
+
+
+def _path_name(path) -> str:
+    parts = []
+    for k in path:
+        parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules, tree):
+    """Resolve a pytree of ``PartitionSpec``s from ``(regex, spec)`` rules.
+
+    Scalar leaves are always replicated (a spec can't partition rank 0);
+    everything else takes the first rule whose regex ``re.search``-matches
+    its '/'-joined tree path. Raises on an unmatched leaf — add a
+    catch-all ``(".*", P())`` tail if silence is wanted (the default
+    table has one).
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    specs = []
+    for path, leaf in flat:
+        name = _path_name(path)
+        if np.ndim(leaf) == 0:
+            specs.append(P())
+            continue
+        for pat, spec in rules:
+            if re.search(pat, name):
+                specs.append(spec)
+                break
+        else:
+            raise ValueError(f"no partition rule matches {name!r}")
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def tree_shardings(mesh: Mesh, tree, rules=None):
+    """Pytree of ``NamedSharding``s for ``tree`` under the rule table —
+    the placement argument for ``put_replicated`` / ``device_put`` when
+    the model axis is real (>1). Specs that name an axis of size 1
+    still produce valid shardings (they behave replicated)."""
+    specs = match_partition_rules(rules or DEFAULT_PARTITION_RULES, tree)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
 
 
 def _cpu_devices(n: int) -> list[jax.Device]:

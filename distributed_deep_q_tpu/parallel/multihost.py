@@ -85,18 +85,24 @@ def is_multiprocess() -> bool:
 
 
 def put_replicated(tree: Any, sharding) -> Any:
-    """Replicate a host pytree onto every device of a (possibly multi-host)
-    mesh. Single-process: plain ``device_put``. Multi-process: every process
-    holds the full value, so the process-local data IS the global array."""
+    """Place a host pytree onto every device of a (possibly multi-host)
+    mesh. ``sharding`` is either ONE sharding applied to every leaf (the
+    replicated TrainState path) or a matching pytree of per-leaf
+    shardings (partition-rule placement, ``parallel.mesh.tree_shardings``
+    — ISSUE 10's model-axis hook). Single-process: plain ``device_put``.
+    Multi-process: every process holds the full value, so the
+    process-local data IS the global array."""
     if not is_multiprocess():
         return jax.device_put(tree, sharding)
 
-    def put(x):
+    def put(x, s):
         x = np.asarray(x)
         return jax.make_array_from_process_local_data(
-            sharding, x, global_shape=x.shape)
+            s, x, global_shape=x.shape)
 
-    return jax.tree.map(put, tree)
+    if isinstance(sharding, jax.sharding.Sharding):
+        return jax.tree.map(lambda x: put(x, sharding), tree)
+    return jax.tree.map(put, tree, sharding)
 
 
 def global_batch(sharding, batch: dict[str, Any]) -> dict[str, Any]:

@@ -115,17 +115,31 @@ class ColumnStage:
 class IngestDrain:
     """Batched host→device transfer thread for a device replay ring.
 
-    Waits until at least ``min_rows`` are staged, then drains them via
-    ``replay.flush()`` under the SHARED replay lock — one traced
-    ``ingest_drain`` hold per batch, off the writer threads. Writers
-    call ``notify()`` (cheap) instead of flushing inline.
+    Waits until the backlog reaches ``min_rows``, then runs the work
+    unit under the SHARED replay lock — one traced ``ingest_drain``
+    hold per batch, off the writer threads. Writers call ``notify()``
+    (cheap) instead of flushing inline.
+
+    The work unit is pluggable (ISSUE 10's shard-aware multi-host
+    drain): by default it is ``replay.flush()`` (the full host→device
+    dispatch) with the staged-row delta as its progress count; a
+    multi-host ring instead passes ``work=prepare_rounds`` (host-only
+    plane assembly — the dispatch there is a lockstep collective the
+    solver enters at the chunk boundary) and ``backlog=_staged_rows``
+    so prepared planes stop re-triggering the thread. ``work`` returns
+    the rows it moved; counters and lock discipline are identical in
+    both modes.
     """
 
-    def __init__(self, replay, lock, min_rows: int, poll_s: float = 0.05):
+    def __init__(self, replay, lock, min_rows: int, poll_s: float = 0.05,
+                 work=None, backlog=None):
         self._replay = replay
         self._lock = lock
         self._min = max(int(min_rows), 1)
         self._poll_s = float(poll_s)
+        self._work = work
+        self._backlog = backlog if backlog is not None \
+            else replay.pending_rows
         self._cv = threading.Condition()
         self._stop = False
         self._drained_rows = 0
@@ -146,20 +160,25 @@ class IngestDrain:
             return {"rows": self._drained_rows,
                     "flushes": self._drain_flushes}
 
+    def _do_work(self) -> int:
+        """One work unit under the replay lock; returns rows moved."""
+        if self._work is not None:
+            return int(self._work())
+        before = self._replay.pending_rows()
+        self._replay.flush()
+        return before - self._replay.pending_rows()
+
     def _run(self) -> None:
         while True:
             with self._cv:
-                while not self._stop \
-                        and self._replay.pending_rows() < self._min:
+                while not self._stop and self._backlog() < self._min:
                     self._cv.wait(timeout=self._poll_s)
                 if self._stop:
                     return
             try:
                 with tracing.locked(self._lock):
                     with tracing.span("ingest_drain"):
-                        before = self._replay.pending_rows()
-                        self._replay.flush()
-                        drained = before - self._replay.pending_rows()
+                        drained = self._do_work()
             except BaseException as e:  # surfaced on counters()/close()
                 with self._cv:
                     self._err = e
@@ -169,15 +188,17 @@ class IngestDrain:
                 self._drain_flushes += 1
 
     def close(self) -> None:
-        """Stop the thread; drain any remainder under the lock (so no
-        staged rows are stranded below the chunk threshold), then
-        re-raise a death the thread recorded."""
+        """Stop the thread; run one final work unit under the lock (so
+        no staged rows are stranded below the chunk threshold — for the
+        multi-host variant this only assembles planes, the lockstep
+        flush dispatches them), then re-raise a death the thread
+        recorded."""
         with self._cv:
             self._stop = True
             self._cv.notify()
         self._thread.join(timeout=10)
         with tracing.locked(self._lock):
-            self._replay.flush()
+            self._do_work()
         with self._cv:
             if self._err is not None:
                 raise RuntimeError("ingest drain thread died") from self._err

@@ -175,6 +175,12 @@ class DeviceFrameReplay:
         self._stages: list | None = None  # built lazily: subclasses widen
         self._pending: list[list[tuple]] = [[] for _ in range(d)]
         self._pending_rows = [0] * d
+        # pre-assembled flush planes (ISSUE 10 shard-aware drain): FIFO
+        # of (idx, cols, rows) built host-side by prepare_rounds; the
+        # next flush() dispatches these BEFORE assembling fresh rounds,
+        # so write order is staged order regardless of who assembled
+        self._prepared: list[tuple[np.ndarray, list, int]] = []
+        self._prepared_rows = 0
         self._drain = None  # optional IngestDrain (start_drain)
         self._drain_enabled = bool(getattr(cfg, "ingest_drain", True))
         self._drain_min = int(getattr(cfg, "drain_min_rows", 0))
@@ -225,9 +231,17 @@ class DeviceFrameReplay:
         return sum(len(m) for m in self.slots)
 
     def pending_rows(self) -> int:
-        """Rows staged but not yet flushed to HBM. Public because writer
-        backpressure (bench.py) and the solver's flush gate key off it —
-        callers must not reach into ``_pending_rows`` (ADVICE r4)."""
+        """Rows staged or pre-assembled but not yet flushed to HBM.
+        Public because writer backpressure (bench.py) and the solver's
+        flush gate key off it — callers must not reach into
+        ``_pending_rows`` (ADVICE r4)."""
+        return sum(self._pending_rows) + self._prepared_rows
+
+    def _staged_rows(self) -> int:
+        """Rows still in staging (NOT counting pre-assembled planes) —
+        the shard-aware drain's backlog signal: once a row is in a
+        prepared plane there is no host work left, only the lockstep
+        dispatch."""
         return sum(self._pending_rows)
 
     @property
@@ -350,30 +364,44 @@ class DeviceFrameReplay:
 
     def _flush_or_notify(self) -> None:
         """Chunk-boundary flush gate. With an ``IngestDrain`` attached
-        the writer only nudges the drain thread (the dispatch happens
-        there, off this thread's lock hold); otherwise the legacy
-        inline flush runs here."""
-        if max(self._pending_rows) < self.write_chunk or self.defer_flush:
+        the writer only nudges the drain thread (the work happens there,
+        off this thread's lock hold); otherwise the legacy inline flush
+        runs here. Multi-host the flush itself is deferred to the
+        lockstep chunk boundary, but the drain still gets the nudge —
+        its work there is host-only plane assembly (prepare_rounds)."""
+        if max(self._pending_rows) < self.write_chunk:
             return
         if self._drain is not None:
             self._drain.notify()
-        else:
+        elif not self.defer_flush:
             self.flush()
 
     def start_drain(self, lock, min_rows: int | None = None):
         """Attach a background staging→device drain thread sharing
         ``lock`` (the caller's replay lock — mutual exclusion with
         writers and the sampler is unchanged). Returns the drain, or
-        None when disabled by config or on multi-host meshes (flushes
+        None when disabled by config.
+
+        Multi-host meshes get a SHARD-AWARE drain (ISSUE 10): flushes
         there are lockstep collectives every process must enter at the
-        same loop point — a free-running thread cannot)."""
+        same loop point, which a free-running thread cannot do — so the
+        drain's work becomes ``prepare_rounds`` (host-only assembly of
+        padded flush planes, zero collectives) keyed off the STAGED
+        backlog, and the agreed-round flush at the chunk boundary only
+        pops planes and dispatches. Same zero-copy columnar path as
+        single-host, minus nothing."""
         if self._drain is not None:
             return self._drain
-        if not self._drain_enabled or self.defer_flush:
+        if not self._drain_enabled:
             return None
         from distributed_deep_q_tpu.replay.columnar import IngestDrain
-        self._drain = IngestDrain(
-            self, lock, min_rows or max(self.write_chunk, self._drain_min))
+        min_r = min_rows or max(self.write_chunk, self._drain_min)
+        if self.defer_flush:
+            self._drain = IngestDrain(self, lock, min_r,
+                                      work=self.prepare_rounds,
+                                      backlog=self._staged_rows)
+        else:
+            self._drain = IngestDrain(self, lock, min_r)
         return self._drain
 
     def stop_drain(self) -> None:
@@ -394,57 +422,93 @@ class DeviceFrameReplay:
         self.slots[slot].seal_stream()
 
     def _flush_rounds_needed(self) -> int:
-        return -(-max((self._pending_rows[s] for s in self.local_shards),
-                      default=0) // self.write_chunk)
+        backlog = -(-max((self._pending_rows[s] for s in self.local_shards),
+                         default=0) // self.write_chunk)
+        return len(self._prepared) + backlog
+
+    def _assemble_round(self) -> tuple[np.ndarray, list, int]:
+        """Build ONE padded write round from staging: ``write_chunk``
+        lanes per LOCAL shard, shards with fewer pending rows padded
+        with out-of-bounds indices the scatter drops. Pure host work (no
+        device dispatch, no collective) — callable from the drain thread
+        under the replay lock. Returns (idx, cols, rows_taken)."""
+        k = self.write_chunk
+        shards = self.local_shards
+        dl = len(shards)
+        idx = np.full((dl, k), self.cap_local, np.int32)  # OOB = drop
+        cols = [np.zeros((dl, k) + tail, dt)
+                for tail, dt in self._stage_columns]
+        rows = 0
+        for li, s in enumerate(shards):
+            if self._columnar:
+                st = (self._stages[s]
+                      if self._stages is not None else None)
+                if st is not None:
+                    taken = st.take(k, [idx] + cols, li)
+                    self._pending_rows[s] -= taken
+                    rows += taken
+                continue
+            fill = 0
+            while self._pending[s] and fill < k:
+                entry = self._pending[s][0]
+                i_arr = entry[0]
+                take = min(len(i_arr), k - fill)
+                idx[li, fill:fill + take] = i_arr[:take]
+                for col, arr in zip(cols, entry[1:]):
+                    col[li, fill:fill + take] = arr[:take]
+                fill += take
+                self._pending_rows[s] -= take
+                rows += take
+                if take == len(i_arr):
+                    self._pending[s].pop(0)
+                else:  # split the chunk, preserving FIFO write order
+                    self._pending[s][0] = tuple(
+                        a[take:] for a in entry)
+        return idx, cols, rows
+
+    def prepare_rounds(self, max_rounds: int | None = None) -> int:
+        """Assemble staged rows into padded flush planes WITHOUT
+        dispatching them — the shard-aware drain's work unit (ISSUE 10).
+        Host-only, so it is safe from a free-running thread even on
+        multi-host meshes where the dispatch itself is a lockstep
+        collective; the planes go out FIFO-first at the next ``flush()``
+        (the fused chunk boundary), so HBM write order is exactly staged
+        order. Returns the number of rows moved into planes."""
+        rounds = -(-max((self._pending_rows[s] for s in self.local_shards),
+                        default=0) // self.write_chunk)
+        if max_rounds is not None:
+            rounds = min(rounds, int(max_rounds))
+        total = 0
+        for _ in range(rounds):
+            plane = self._assemble_round()
+            self._prepared.append(plane)
+            self._prepared_rows += plane[2]
+            total += plane[2]
+        return total
 
     def flush(self) -> None:
         """Push all staged frames to HBM in fixed-shape chunks.
 
-        Every flush writes ``write_chunk`` lanes per LOCAL shard (one
-        compiled program); shards with fewer pending frames pad with
-        out-of-bounds indices that the scatter drops. Multi-host: the
-        scatter is a global-array computation — a collective every
-        process must enter the same number of times — so the round count
-        is MAX-agreed across processes first (``global_max_int``) and
-        short hosts dispatch all-padding chunks. Every process must
-        therefore call ``flush()`` at the same loop point (the fused
-        chunk boundary does; ingest defers via ``defer_flush``).
+        Pre-assembled planes (``prepare_rounds``) dispatch first, then
+        fresh rounds assemble from staging. Multi-host: the scatter is a
+        global-array computation — a collective every process must enter
+        the same number of times — so the round count is MAX-agreed
+        across processes first (``global_max_int``) and short hosts
+        dispatch all-padding chunks. Every process must therefore call
+        ``flush()`` at the same loop point (the fused chunk boundary
+        does; ingest defers via ``defer_flush``).
         """
         rounds = self._flush_rounds_needed()
         if self._pc > 1:
             from distributed_deep_q_tpu.parallel.multihost import (
                 global_max_int)
             rounds = global_max_int(rounds)
-        k = self.write_chunk
-        shards = self.local_shards
         for _ in range(rounds):
-            dl = len(shards)
-            idx = np.full((dl, k), self.cap_local, np.int32)  # OOB = drop
-            cols = [np.zeros((dl, k) + tail, dt)
-                    for tail, dt in self._stage_columns]
-            for li, s in enumerate(shards):
-                if self._columnar:
-                    st = (self._stages[s]
-                          if self._stages is not None else None)
-                    if st is not None:
-                        self._pending_rows[s] -= st.take(
-                            k, [idx] + cols, li)
-                    continue
-                fill = 0
-                while self._pending[s] and fill < k:
-                    entry = self._pending[s][0]
-                    i_arr = entry[0]
-                    take = min(len(i_arr), k - fill)
-                    idx[li, fill:fill + take] = i_arr[:take]
-                    for col, arr in zip(cols, entry[1:]):
-                        col[li, fill:fill + take] = arr[:take]
-                    fill += take
-                    self._pending_rows[s] -= take
-                    if take == len(i_arr):
-                        self._pending[s].pop(0)
-                    else:  # split the chunk, preserving FIFO write order
-                        self._pending[s][0] = tuple(
-                            a[take:] for a in entry)
+            if self._prepared:
+                idx, cols, rows = self._prepared.pop(0)
+                self._prepared_rows -= rows
+            else:
+                idx, cols, _ = self._assemble_round()
             self._apply_write(idx, cols)
 
     def _apply_write(self, idx: np.ndarray, cols: list) -> None:

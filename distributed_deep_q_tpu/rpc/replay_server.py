@@ -940,6 +940,15 @@ class ReplayFeedServer:
                 pending = getattr(self.replay, "pending_rows", None)
                 if pending is not None:
                     out["queue/staged_rows"] = int(pending())
+                # per-shard data plane (ISSUE 10): each multi-host
+                # learner process serves exactly its hash-assigned actor
+                # slice, so this server's replay IS the shard — expose
+                # its fill, its ingest rate, and which host owns it (the
+                # probe the linearity bench and ops dashboards key on).
+                # _pid avoids importing jax here; 0 on host-RAM replays
+                out["shard/rows"] = len(self.replay)
+                out["shard/owner_host"] = int(
+                    getattr(self.replay, "_pid", 0))
         out["fleet/actors_seen"] = len(self.last_seen)
         if self._drain is not None:
             dc = self._drain.counters()
@@ -951,6 +960,9 @@ class ReplayFeedServer:
         out["flow/shed_total"] = fc["shed_total"]
         out["flow/consume_rate"] = round(fc["consume_rate"], 3)
         out["flow/ingest_rate"] = round(fc["ingest_rate"], 3)
+        # shard-local ingest rate: with per-host data planes this equals
+        # the flow-plane rate because nothing else feeds the shard
+        out["shard/ingest_rate"] = round(fc["ingest_rate"], 3)
         if tracing.ENABLED:  # span-buffer/drop + clock-skew gauges
             out.update(tracing.counters())
         return out
@@ -987,6 +999,22 @@ class ReplayFeedClient:
         sock = socket.create_connection(self._addr, timeout=self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = faultinject.wrap(sock, side="client")
+
+    def rehost(self, host: str, port: int) -> None:
+        """Point the stub at a new server address. The live socket (if
+        any) is dropped so the NEXT call reconnects to the new address —
+        a learner host changing address is just a reconnect, which is
+        what makes consistent-hash actor→host assignment (ISSUE 10)
+        ride the existing resilience plane: the actor's HOST (hash slot)
+        is stable, only its transport endpoint moves."""
+        with self._lock:
+            self._addr = (host, port)
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
 
     def call(self, method: str, **kwargs: Any) -> dict[str, Any]:
         with self._lock:

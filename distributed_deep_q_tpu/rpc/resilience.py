@@ -272,6 +272,14 @@ class ResilientReplayFeedClient:
                 return
             time.sleep(min(remaining, 0.2))
 
+    def rehost(self, host: str, port: int) -> None:
+        """Repoint at a moved server (same hash-assigned host, new
+        address — ISSUE 10's reconnect seam). The next call reconnects
+        through the normal retry path; in-flight idempotency state
+        (``flush_seq``, credits) carries over because the HOST — and
+        hence the server-side dedup/ledger identity — is unchanged."""
+        self._client.rehost(host, port)
+
     def get_params(self, have_version: int = -1):
         """Returns (version, weights-or-None) like the raw stub."""
         return self._run("get_params",

@@ -1,0 +1,249 @@
+"""Multi-host sharded-replay bench worker — spawned by bench.py.
+
+One simulated learner host of an N-host multi-controller run (ISSUE 10
+``multihost_curve``). Every host owns a FULL local data plane: its
+replay shard slice of the global device ring, a local ``ReplayFeedServer``
+fed only by its consistent-hash-assigned writers (actors/assignment.py),
+the shard-aware ingest drain, local PER sampling, and per-shard priority
+write-back. The single cross-host interaction is the ``lax.pmean``
+inside the fused train step (plus the lockstep-flush round agreement,
+a scalar MAX) — which is exactly what the curve measures.
+
+Workload is FIXED GLOBALLY across host counts (strong scaling): global
+batch, global ring capacity, global device count, and the global ingest
+target are constants; each of the N hosts carries 1/N of every plane.
+On a real pod each host has its own chips, so the wall step rate would
+hold flat as N grows; this container time-slices all N processes on the
+SAME cores, so the honest headline per point is the AGGREGATE per-host
+plane throughput (wall steps/s x N). That aggregate is linear in N iff
+the sharing overhead — the allreduce plus lockstep agreement — stays
+small; any cross-host replay traffic or O(global) per-host work would
+crater it. bench.py records both the wall and the aggregate rate.
+
+Collective discipline: every process runs the SAME dispatch counts
+(warmup / settle / reps, with the per-rep dispatch count agreed via
+``global_max_int``), so the in-step pmean and the flush round agreement
+always pair up across hosts. All host-local work (prepare_rounds in the
+drain, RPC serving, pacing) stays off the collective path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# fixed GLOBAL workload — identical at every host count (strong scaling)
+DEVICES = 4          # global dp mesh size (virtual CPU devices)
+BATCH = 64           # global train batch
+CAPACITY = 8192      # global frame-ring capacity
+STREAMS = 2          # writer streams PER HOST (fleet = STREAMS * n_hosts)
+CHAIN = 8            # fused grad steps per dispatch
+FRAME = (36, 36)     # Nature conv stack minimum — the dry-run shape
+WRITE_CHUNK = 32
+PREFILL_PER_HOST = 480
+REPS = 5
+
+
+def _writer(client, stop, rate_tps: float, seed: int, counter, ci: int,
+            errs: list):
+    """Paced RPC writer for one local stream — frames/actions/rewards
+    from the stream's own rng, short episodes so slots seal steadily."""
+    rng = np.random.default_rng(seed)
+    # big batches: on this synchronous-CPU fallback the dispatch loop
+    # holds the replay lock for nearly the whole step, so each inter-
+    # dispatch yield admits ~one RPC per writer — the rows it carries
+    # set the achievable ingest rate
+    rows = 256
+    period = rows / max(rate_tps, 1e-6)
+    nxt = time.perf_counter()
+    while not stop.is_set():
+        batch = {
+            "frame": rng.integers(0, 255, (rows,) + FRAME, dtype=np.uint8),
+            "action": rng.integers(0, 4, rows).astype(np.int32),
+            "reward": rng.standard_normal(rows).astype(np.float32),
+            "done": (rng.random(rows) < 1 / 9).astype(bool),
+        }
+        try:
+            resp = client.add_transitions(**batch)
+        except Exception:
+            if not stop.is_set():  # teardown races are expected
+                import traceback
+                errs.append(traceback.format_exc())
+            return
+        if resp.get("ok"):
+            counter[ci] += rows
+        nxt += period
+        delay = nxt - time.perf_counter()
+        if delay > 0:
+            stop.wait(delay)
+
+
+def main() -> None:
+    pid, nproc = int(sys.argv[1]), int(sys.argv[2])
+    port, out_path = sys.argv[3], sys.argv[4]
+    target_tps = float(sys.argv[5])  # GLOBAL ingest target, split /nproc
+
+    from distributed_deep_q_tpu.config import (
+        Config, MeshConfig, NetConfig, ReplayConfig)
+    from distributed_deep_q_tpu.parallel.multihost import (
+        all_processes_ready, global_max_int, initialize_multihost)
+
+    mesh_cfg = MeshConfig(backend="cpu", num_fake_devices=DEVICES,
+                          dp=DEVICES, coordinator=f"127.0.0.1:{port}",
+                          num_processes=nproc, process_id=pid)
+    if nproc == 1:
+        # single-host reference point: initialize_multihost is a no-op,
+        # pin the platform + device count the conftest way
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from distributed_deep_q_tpu.compat import set_cpu_device_count
+        set_cpu_device_count(DEVICES, exact=True)
+    initialize_multihost(mesh_cfg)
+
+    import jax
+
+    # NO persistent compile cache here, deliberately: executables
+    # deserialized from bench.py's .jax_cache segfault inside the gloo
+    # collectives on the multi-process CPU backend (reproduced at 4
+    # hosts: fresh compiles pass 3/3, cache hits SIGSEGV the leader).
+    # The tiny curve shapes recompile in seconds; correctness wins.
+
+    from distributed_deep_q_tpu.actors.assignment import local_slice
+    from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
+    from distributed_deep_q_tpu.rpc.replay_server import (
+        ReplayFeedClient, ReplayFeedServer)
+    from distributed_deep_q_tpu.solver import Solver
+
+    cfg = Config()
+    cfg.mesh = mesh_cfg
+    cfg.net = NetConfig(kind="nature_cnn", num_actions=4, frame_shape=FRAME)
+    cfg.replay = ReplayConfig(capacity=CAPACITY, batch_size=BATCH, n_step=2,
+                              prioritized=True, device_per=True,
+                              write_chunk=WRITE_CHUNK)
+    solver = Solver(cfg)
+    replay = DevicePERFrameReplay(cfg.replay, solver.mesh, FRAME, stack=4,
+                                  gamma=0.99, seed=0,
+                                  write_chunk=WRITE_CHUNK,
+                                  num_streams=STREAMS)
+
+    # prefill this host's streams directly (no pacing), then one lockstep
+    # flush drains every staged round on every host
+    rng = np.random.default_rng(1000 + pid)
+    per_stream = PREFILL_PER_HOST // STREAMS
+    for s in range(STREAMS):
+        replay.add_batch({
+            "frame": rng.integers(0, 255, (per_stream,) + FRAME,
+                                  dtype=np.uint8),
+            "action": rng.integers(0, 4, per_stream).astype(np.int32),
+            "reward": rng.standard_normal(per_stream).astype(np.float32),
+            "done": (np.arange(per_stream) % 9 == 8),
+        }, stream=s)
+    replay.flush()
+    assert all_processes_ready(replay.ready(BATCH)), \
+        "prefill left a shard empty — every host must be sampleable"
+
+    # local data plane: this host's feed server + shard-aware drain; the
+    # consistent-hash ring says which gids this host serves (the wire
+    # actor_id is the LOCAL stream, exactly the supervisor's mapping)
+    server = ReplayFeedServer(replay)
+    fleet = STREAMS * nproc
+    gids = local_slice(fleet, nproc, pid)
+    stop = threading.Event()
+    counter = [0] * STREAMS
+    errs: list[str] = []
+    writers = []
+    for s in range(STREAMS):
+        client = ReplayFeedClient("127.0.0.1", server.address[1], actor_id=s)
+        th = threading.Thread(
+            target=_writer, name=f"writer-{s}",
+            args=(client, stop, target_tps / fleet, 5000 + gids[s],
+                  counter, s, errs), daemon=True)
+        th.start()
+        writers.append(th)
+
+    def dispatch() -> None:
+        with server.replay_lock:
+            solver.train_steps_device_per(replay, chain=CHAIN)
+        # scheduling yield: on the synchronous-CPU fallback the dispatch
+        # runs to completion INSIDE the lock hold (a real accelerator
+        # dispatches async and releases in microseconds), so without a
+        # gap the serve threads starve behind an always-held RLock. The
+        # 10 ms mirrors the inter-dispatch host work a production loop
+        # has anyway, and is charged to the measured wall time.
+        time.sleep(0.01)
+
+    def fence() -> None:
+        jax.block_until_ready(solver.state.params)
+
+    # warmup (compile) + calibration; the per-rep dispatch count must be
+    # AGREED or hosts would desync their collective sequences
+    for _ in range(2):
+        dispatch()
+    fence()
+    t0 = time.perf_counter()
+    for _ in range(2):
+        dispatch()
+    fence()
+    per_dispatch = (time.perf_counter() - t0) / 2
+    # floor of 3 dispatches per rep: averaging across dispatches is what
+    # keeps the per-point spread under the 0.05 gate on a noisy 1-core
+    # container (single-dispatch reps measured up to ~5% jitter, and the
+    # paced RPC admissions land unevenly across short reps)
+    k = int(min(max(round(2.0 / max(per_dispatch, 1e-6)), 3), 40))
+    k = global_max_int(k)
+
+    # settle window (discarded) re-anchors the achieved-ingest counter
+    # past the writers' ramp — PR 9's fenced settled-window discipline.
+    # k+2 dispatches: at the 4-host point one window is not enough to
+    # flush scheduler warm-in, and a low first rep blows the spread gate
+    for _ in range(k + 2):
+        dispatch()
+    fence()
+    ingest_t0, ingest_c0 = time.perf_counter(), sum(counter)
+
+    rates = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            dispatch()
+        fence()
+        rates.append(k * CHAIN / (time.perf_counter() - t0))
+    ingest = ((sum(counter) - ingest_c0)
+              / (time.perf_counter() - ingest_t0))
+
+    stop.set()
+    for th in writers:
+        th.join(timeout=10.0)
+    # ledger BEFORE close: every add this server ever saw, by actor id —
+    # the zero-cross-host-RPC evidence (foreign ids would show up here)
+    summary = server.telemetry_summary()
+    seen = sorted(int(a) for a in server.last_seen)
+    server.close()
+
+    local_ids = list(range(STREAMS))
+    out = {
+        "pid": pid,
+        "n_hosts": nproc,
+        "rates": [round(r, 3) for r in rates],
+        "dispatch_k": k,
+        "ingest_t_per_s": round(ingest, 1),
+        "assigned_gids": [int(g) for g in gids],
+        "actor_ids_seen": seen,
+        "rpc_add_calls": int(summary.get("rpc/add_transitions_calls", 0)),
+        "foreign_actor_calls": sum(1 for a in seen if a not in local_ids),
+        "shard_rows": int(summary.get("shard/rows", 0)),
+        "writer_errors": errs[:2],
+    }
+    with open(out_path, "w") as fh:
+        json.dump(out, fh)
+
+
+if __name__ == "__main__":
+    main()

@@ -36,22 +36,32 @@ SPREAD_KEY = {
     "idle_uniform_steps_per_s": "idle_spread",
     "pallas_off_steps_per_s": "idle_spread",
     "flagship_under_ingest_steps_per_s": "under_ingest_spread",
+    # linearity ratios divide two curve points, so their run-to-run
+    # spread is the (first-order) SUM of the points' spreads — the bench
+    # records that sum next to each ratio
+    "multihost_linearity_2x": "multihost_linearity_2x_spread",
+    "multihost_linearity_4x": "multihost_linearity_4x_spread",
 }
 
 # substrings marking metrics where UP is the bad direction
+# (_rpcs: cross_host_replay_rpcs is a badness LEDGER — any cross-host
+# replay traffic is a sharding violation, so up must gate, and the
+# common old=0 case makes any appearance an infinite regression)
 _LOWER_BETTER = ("_ms", "_fusions", "_convs", "_copies", "fusions",
-                 "spread")
+                 "spread", "_rpcs")
 # keys that are configuration echoes / identities, not metrics
 # (max_in_flight_rows is the writers' backpressure watermark — a state
 # echo of the pacing loop, not a quality axis with a bad direction;
 # inference_curve's SLO/batch knobs are config echoes, sheds a state
 # echo, and local_actions_per_s the comparison-host baseline the
-# speedup already folds in — gating it would gate host CPU noise)
+# speedup already folds in — gating it would gate host CPU noise;
+# multihost_curve's n_hosts is the point's identity and dispatch_k its
+# calibration echo)
 _SKIP = ("_chain_k", "_vs_", "vs_baseline", "ring_capacity",
          "flagship_batch", "concurrent_writers", "peak_flops", "n", "rc",
          "flops_per_step", "max_in_flight_rows", "inference_slo_ms",
          "inference_max_batch", "inference_cutoff_us", "sheds",
-         "local_actions_per_s")
+         "local_actions_per_s", "n_hosts", "dispatch_k")
 
 
 def _parsed(path: str) -> dict:

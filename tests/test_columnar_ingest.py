@@ -136,6 +136,42 @@ def test_device_ring_columnar_bitwise_equals_legacy():
                                       err_msg=f"sum tree slot {g}")
 
 
+# -- shard-aware drain (ISSUE 10): prepare_rounds ≡ inline assembly --------
+def test_prepare_rounds_then_flush_bitwise_equals_direct_flush():
+    """The multi-host drain's work unit pre-assembles flush planes
+    host-side (``prepare_rounds``) and the next ``flush()`` dispatches
+    them before assembling fresh rounds. Splitting assembly from
+    dispatch must not change a single ring byte, metadata lane, or
+    seeded priority versus the inline flush — otherwise the multi-host
+    drain would diverge from the single-host semantics it offloads."""
+    from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
+
+    mesh = make_mesh(MeshConfig(backend="cpu", num_fake_devices=8, dp=2))
+    cfg_kw = dict(capacity=512, batch_size=32, n_step=3, prioritized=True,
+                  device_per=True, write_chunk=16)
+    pre = DevicePERFrameReplay(ReplayConfig(**cfg_kw), mesh, (8, 8),
+                               stack=4, gamma=0.99, seed=0, write_chunk=16,
+                               num_streams=2)
+    ref = DevicePERFrameReplay(ReplayConfig(**cfg_kw), mesh, (8, 8),
+                               stack=4, gamma=0.99, seed=0, write_chunk=16,
+                               num_streams=2)
+    for r in (pre, ref):
+        _stream(r, 300)
+    # pre: assemble every full round host-side, then dispatch; a second
+    # prepare_rounds must find nothing full left to assemble
+    assert pre.prepare_rounds() > 0
+    assert pre.prepare_rounds() == 0
+    assert pre.pending_rows() == ref.pending_rows()  # prepared still pend
+    pre.flush()
+    ref.flush()
+    assert pre.pending_rows() == ref.pending_rows() == 0
+    for field in ("frames", "action", "reward", "done", "boundary",
+                  "prio", "maxp"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(pre.dstate, field)),
+            np.asarray(getattr(ref.dstate, field)), err_msg=field)
+
+
 # -- drain thread -----------------------------------------------------------
 def test_ingest_drain_flushes_off_thread():
     """Writers stage + notify; the drain owns the flush. After the

@@ -144,6 +144,11 @@ def test_stats_rpc_matches_actor_sent_counters():
         assert stats["queue/params_version"] == version
         assert stats["queue/params_version_lag"] == 0
         assert stats["fleet/actors_seen"] == 1
+        # shard gauges must land for host-RAM replays too (no
+        # pending_rows): the server's replay IS the shard, owner 0
+        assert stats["shard/rows"] == n
+        assert stats["shard/owner_host"] == 0
+        assert "shard/ingest_rate" in stats
     finally:
         client.close()
         server.close()
