@@ -839,6 +839,162 @@ def bench_inference(cfg_mod, on_cpu: bool, out: dict) -> None:
     out["inference_slo_ms"] = icfg.slo_ms
 
 
+def bench_actor_curve(cfg_mod, on_cpu: bool, out: dict) -> None:
+    """Vectorized acting plane (ISSUE 11): end-to-end actions/s, ingest
+    t/s, and whole-tick p99 vs env count, on the production topology —
+    one ``VectorActing`` stack per point, greedy actions through ONE
+    ``infer`` RPC per wall tick, transitions flushed per-row through the
+    columnar ``add_transitions`` wire into a device ring behind a
+    ``ReplayFeedServer``.
+
+    Every component is the real one (``select_actions``' ε-split means
+    the infer batch is the greedy SUBSET of rows, exactly like the
+    supervisor's loop); only the learner is absent, so the curve answers
+    "what does the acting plane alone sustain at N envs" — on a CPU
+    container that is a Python-loop figure (the signal env and the wire
+    dominate), labeled honestly as such in PERF.md §14, not a TPU claim.
+    """
+    from distributed_deep_q_tpu.actors.supervisor import actor_epsilon
+    from distributed_deep_q_tpu.actors.vector import (
+        VectorActing, make_vector_env)
+    from distributed_deep_q_tpu.models.policy import BatchedPolicy
+    from distributed_deep_q_tpu.parallel.mesh import make_mesh
+    from distributed_deep_q_tpu.replay.device_ring import DeviceFrameReplay
+    from distributed_deep_q_tpu.rpc.inference_server import (
+        InferenceClient, InferenceServer)
+    from distributed_deep_q_tpu.rpc.replay_server import (
+        ReplayFeedClient, ReplayFeedServer)
+
+    import jax
+
+    hw, stack, n_act = (10, 10), 2, 4
+    env_cfg = cfg_mod.EnvConfig(id="signal", kind="signal_atari",
+                                frame_shape=hw, stack=stack)
+    net = cfg_mod.NetConfig(kind="mlp", num_actions=n_act, hidden=(32, 32),
+                            frame_shape=hw, stack=stack)
+    icfg = cfg_mod.InferenceConfig()
+    acfg = cfg_mod.ActorConfig()
+    seed = 0
+    duration = 1.2 if on_cpu else 2.4
+    env_counts = (2, 8, 32) if on_cpu else (8, 32, 128)
+    mcfg = cfg_mod.MeshConfig(
+        backend="cpu" if jax.devices()[0].platform == "cpu" else "tpu",
+        dp=1)
+    if mcfg.backend == "cpu":
+        mcfg.num_fake_devices = max(len(jax.devices("cpu")), 1)
+    mesh = make_mesh(mcfg)
+    curve: dict = {}
+    for n in env_counts:
+        # fresh planes per point: clean shed counters, clean ring
+        policy = BatchedPolicy(net, seed=seed,
+                               obs_dim=int(np.prod(hw)) * stack,
+                               buckets=icfg.buckets)
+        isrv = InferenceServer(policy, max_batch=icfg.max_batch,
+                               cutoff_us=icfg.cutoff_us)
+        ihost, iport = isrv.address
+        replay = DeviceFrameReplay(
+            cfg_mod.ReplayConfig(capacity=8192, batch_size=32,
+                                 prioritized=False),
+            mesh, hw, stack=stack, gamma=0.99, seed=seed, write_chunk=64,
+            num_streams=n)
+        fsrv = ReplayFeedServer(replay)
+        fhost, fport = fsrv.address
+        cli = InferenceClient(ihost, iport, actor_id=0)
+        feeds = [ReplayFeedClient(fhost, fport, actor_id=j)
+                 for j in range(n)]
+        # fleet seeding discipline: row j IS fleet gid j (one process)
+        acting = VectorActing(
+            make_vector_env(env_cfg,
+                            [seed + 1000 * (g + 1) for g in range(n)]),
+            stack,
+            [np.random.default_rng(seed + 7777 * (g + 1))
+             for g in range(n)],
+            [actor_epsilon(g, n, acfg.eps_base, acfg.eps_alpha)
+             for g in range(n)])
+        sheds = [0]
+
+        def greedy_fn(rows, cli=cli, sheds=sheds):
+            while True:
+                resp = cli.infer(rows)
+                if resp.get("shed"):
+                    sheds[0] += 1
+                    time.sleep(float(resp.get("retry_after_ms", 10)) / 1e3)
+                    continue
+                return np.asarray(resp["actions"])
+
+        chunks = [{k: [] for k in ("frame", "action", "reward", "done",
+                                   "boundary")} for _ in range(n)]
+
+        def flush(j, chunks=chunks, feeds=feeds):
+            ch = chunks[j]
+            if not ch["action"]:
+                return
+            feeds[j].add_transitions(
+                frame=np.stack(ch["frame"]).astype(np.uint8),
+                action=np.asarray(ch["action"], np.int32),
+                reward=np.asarray(ch["reward"], np.float32),
+                done=np.asarray(ch["done"], bool),
+                boundary=np.asarray(ch["boundary"], bool))
+            for q in ch.values():
+                q.clear()
+
+        def tick(acting=acting, chunks=chunks, n=n):
+            frames, actions, rewards, dones, overs = acting.tick(greedy_fn)
+            for j in range(n):
+                ch = chunks[j]
+                ch["frame"].append(frames[j])
+                ch["action"].append(int(actions[j]))
+                ch["reward"].append(float(rewards[j]))
+                ch["done"].append(bool(dones[j]))
+                ch["boundary"].append(bool(overs[j]))
+                if len(ch["action"]) >= acfg.send_batch:
+                    flush(j)
+
+        try:
+            settle_end = time.perf_counter() + 0.4  # bucket compiles
+            while time.perf_counter() < settle_end:
+                tick()
+            c0 = fsrv.counters()["env_steps"]
+            t_start = time.perf_counter()
+            stamps: list[float] = []
+            tick_ms: list[float] = []
+            while time.perf_counter() < t_start + duration:
+                t0 = time.perf_counter()
+                tick()
+                t1 = time.perf_counter()
+                stamps.append(t1)
+                tick_ms.append(1e3 * (t1 - t0))
+            for j in range(n):  # remainders land before the ingest read
+                flush(j)
+            wall = time.perf_counter() - t_start
+            ingest = (fsrv.counters()["env_steps"] - c0) / wall
+            # 3 equal sub-windows of the tick stream → per-point spread
+            edges = [t_start + wall * k / 3 for k in range(4)]
+            reps = []
+            for k in range(3):
+                cnt = sum(1 for s in stamps if edges[k] <= s < edges[k + 1])
+                reps.append(cnt * n / (wall / 3))
+            rate = float(np.median(reps))
+            curve[str(n)] = {
+                "n_envs": n,  # echoed for the reader; skipped by the gate
+                "actions_per_s": round(rate, 1),
+                "ingest_t_per_s": round(ingest, 1),
+                "tick_p99_ms": (round(float(np.percentile(tick_ms, 99)), 3)
+                                if tick_ms else None),
+                "sheds": int(sheds[0]),
+                "spread": (round((max(reps) - min(reps)) / rate, 4)
+                           if rate > 0 else None),
+            }
+        finally:
+            cli.close()
+            for c in feeds:
+                c.close()
+            fsrv.close()
+            isrv.close()
+            del replay
+    out["actor_curve"] = curve
+
+
 def trace_ingest(cfg_mod, on_cpu: bool) -> None:
     """Ingest-attribution mode (``--trace-ingest``): run a flagship-shaped
     learner under paced writer ingest with the tracer at sample_rate=1,
@@ -1175,6 +1331,10 @@ def main() -> None:
     note("inference")
     # -- batched inference plane: actions/s + p99 vs client count ---------
     bench_inference(cfg_mod, on_cpu, out)
+
+    note("actor_curve")
+    # -- vectorized acting plane: actions/s + ingest vs env count ---------
+    bench_actor_curve(cfg_mod, on_cpu, out)
 
     note("flagship")
     # -- flagship: PER + 1M ring + concurrent actor ingest ----------------

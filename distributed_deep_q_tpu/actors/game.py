@@ -425,6 +425,24 @@ def make_env(cfg: EnvConfig, seed: int = 0) -> Env:
     raise ValueError(f"unknown env kind {cfg.kind!r}")
 
 
+def make_envs(cfgs, seeds) -> list[Env]:
+    """Vector-aware ``make_env``: one env per (cfg, seed) row.
+
+    ``cfgs`` is one EnvConfig (replicated across rows) or a per-row
+    sequence (multi-game fleets pass ``env_for_actor`` output per
+    global id). This is the seam ``actors/vector.py`` stacks behind a
+    ``VectorEnv`` — building rows HERE keeps the per-row seeding
+    discipline identical to the per-process fleet, which is what the
+    bitwise-parity guarantee rides on. Telemetry wrappers go AROUND
+    the vector (``VectorStepLatencyEnv``), never around row 0.
+    """
+    if not isinstance(cfgs, (list, tuple)):
+        cfgs = [cfgs] * len(seeds)
+    if len(cfgs) != len(seeds):
+        raise ValueError(f"{len(cfgs)} env configs vs {len(seeds)} seeds")
+    return [make_env(c, seed=int(s)) for c, s in zip(cfgs, seeds)]
+
+
 class FrameStacker:
     """Maintains the rolling [H, W, stack] uint8 observation for pixel envs.
 

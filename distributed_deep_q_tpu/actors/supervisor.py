@@ -338,7 +338,15 @@ class _RemoteInference:
 
     def action(self, obs) -> int:
         """One remote argmax action for a single observation."""
-        batch = np.ascontiguousarray(np.asarray(obs)[None])
+        return int(self.actions(np.asarray(obs)[None])[0])
+
+    def actions(self, obs) -> np.ndarray:
+        """Batched remote argmax actions: ONE ``infer`` RPC for a whole
+        row batch — the vector actor's one-RPC-per-wall-tick path. A
+        shed sheds the WHOLE batch (the server admits whole requests
+        only), so retry keeps the rows together and row order is
+        preserved end to end."""
+        batch = np.ascontiguousarray(np.asarray(obs))
         seq = self._seq
         self._seq += 1
         while True:
@@ -361,7 +369,7 @@ class _RemoteInference:
             self._client._note_reply(resp)
             if resp.get("version") is not None:
                 self.version = int(resp["version"])
-            return int(np.asarray(resp["actions"])[0])
+            return np.asarray(resp["actions"]).astype(np.int64)
 
     def close(self) -> None:
         self._client.close()
@@ -403,6 +411,13 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
         ResilientReplayFeedClient, RetryPolicy)
 
     from distributed_deep_q_tpu.config import env_for_actor
+    if int(cfg.actors.vector_envs) > 1 and cfg.net.kind != "r2d2":
+        # Sebulba mode (ISSUE 11): this process drives vector_envs
+        # stacked env copies behind one batched step — same identities,
+        # same wire path, V streams
+        _vector_actor_loop(cfg, host, port, actor_id, stop_event,
+                           max_env_steps)
+        return
     # global identity: actor_id is the LOCAL id (= per-host replay stream);
     # seeding and the ε ladder use the fleet-global id so multi-host slices
     # decorrelate instead of repeating each other (config 5 full shape).
@@ -580,6 +595,212 @@ def actor_main(cfg: Config, host: str, port: int, actor_id: int,
         if remote is not None:
             remote.close()
         client.close()
+        if tracing.ENABLED:
+            tracing.export()
+
+
+def _liveness_id(cfg: Config, actor_id: int) -> int:
+    """The ``last_seen`` key a vector actor's heartbeat lane uses.
+
+    In vector mode the replay STREAM ids are ``process*V + row``, so
+    process p's row-r stream would alias process ``p*V + r``'s liveness
+    key — a live process 0 could mask a dead process 1 forever. The
+    heartbeat client therefore signs in on a lane BEYOND the stream
+    range (``num_actors*V + process``); streams keep their own ids."""
+    v = max(int(cfg.actors.vector_envs), 1)
+    return cfg.actors.num_actors * v + actor_id if v > 1 else actor_id
+
+
+def _vector_actor_loop(cfg: Config, host: str, port: int, actor_id: int,
+                       stop_event, max_env_steps: int = 0) -> None:
+    """Vectorized actor process body (ISSUE 11, Sebulba half of the
+    Podracer split): V stacked envs, one batched policy call per wall
+    tick, V per-row replay streams down the existing columnar wire path.
+
+    Identity discipline is what makes this a MODE and not a fork: row j
+    of process i plays fleet-global id ``base*V + j`` (``base`` = this
+    process's gid), with exactly the per-env fleet's seeds — env seed
+    ``seed + 1000*(gid+1)``, ε rng ``seed + 7777*(gid+1)``, ε ladder
+    slot ``gid`` of ``num_actors*V`` — and ships on replay stream
+    ``actor_id*V + j``. Same seeds → same actions → same transitions,
+    bitwise (tests/test_vector_env.py pins it on both torsos).
+    """
+    from distributed_deep_q_tpu.actors.game import make_envs
+    from distributed_deep_q_tpu.actors.vector import (
+        VectorActing, VectorEnv, VectorStepLatencyEnv)
+    from distributed_deep_q_tpu.config import env_for_actor
+    from distributed_deep_q_tpu.models.qnet import QNet
+    from distributed_deep_q_tpu.rpc.resilience import (
+        ResilientReplayFeedClient, RetryPolicy)
+
+    v = int(cfg.actors.vector_envs)
+    base = (cfg.actors.actor_gids[actor_id] if cfg.actors.actor_gids
+            else actor_id + cfg.actors.actor_id_offset)
+    gids = [base * v + j for j in range(v)]
+    fleet = cfg.actors.fleet_size or cfg.actors.num_actors * v
+    venv = VectorStepLatencyEnv(VectorEnv(make_envs(
+        [env_for_actor(cfg.env, g) for g in gids],
+        [cfg.train.seed + 1000 * (g + 1) for g in gids])))
+    cfg.net.num_actions = venv.num_actions
+    # ONE shared θ copy: every per-env actor seeds its QNet with
+    # cfg.train.seed, so one net IS all of them
+    qnet = QNet(cfg.net, seed=cfg.train.seed,
+                obs_dim=int(np.prod(venv.obs_shape)))
+
+    def _policy() -> "RetryPolicy":
+        return RetryPolicy(base_delay=cfg.actors.rpc_retry_base,
+                           max_delay=cfg.actors.rpc_retry_max,
+                           deadline=cfg.actors.rpc_retry_deadline)
+
+    # per-row stream clients: stream id actor_id*V + j keeps the
+    # server-side contract intact — flush_seq dedup, slot ownership,
+    # and per-stream telemetry all key on it, exactly as V processes
+    clients = []
+    for j, g in enumerate(gids):
+        c = ResilientReplayFeedClient.connect(
+            host, port, actor_id=actor_id * v + j, policy=_policy(),
+            timeout=cfg.actors.rpc_call_timeout,
+            should_abort=stop_event.is_set,
+            seed=cfg.train.seed + 31337 * (g + 1))
+        c.call("reset_stream")
+        clients.append(c)
+    # heartbeat/θ lane on its own liveness id (see _liveness_id) with a
+    # DEDICATED rng: _ActorComms draws its pull phase at construction,
+    # and that draw must not perturb any row's ε stream
+    comms_client = ResilientReplayFeedClient.connect(
+        host, port, actor_id=_liveness_id(cfg, actor_id), policy=_policy(),
+        timeout=cfg.actors.rpc_call_timeout,
+        should_abort=stop_event.is_set,
+        seed=cfg.train.seed + 31337 * (fleet + actor_id + 1))
+    comms = _ActorComms(cfg, comms_client, qnet,
+                        np.random.default_rng(
+                            cfg.train.seed + 4242 * (actor_id + 1)))
+    comms_client.on_backpressure = comms.touch
+    for c in clients:
+        c.on_backpressure = comms.touch
+
+    rngs = [np.random.default_rng(cfg.train.seed + 7777 * (g + 1))
+            for g in gids]
+    epsilons = [actor_epsilon(g, fleet, cfg.actors.eps_base,
+                              cfg.actors.eps_alpha) for g in gids]
+    acting = VectorActing(venv, cfg.env.stack, rngs, epsilons)
+
+    remote = None
+    if cfg.inference.enabled:
+        remote = _RemoteInference(cfg, stop_event, actor_id * v, base,
+                                  touch=comms.touch)
+
+    infer_ms: list[float] = []
+    infer_rows: list[float] = []
+
+    def greedy_fn(rows: np.ndarray) -> np.ndarray:
+        if remote is not None:
+            with tracing.span_sampled("vector_infer"):
+                t0 = time.perf_counter()
+                out = remote.actions(rows)
+            infer_ms.append(1e3 * (time.perf_counter() - t0))
+            infer_rows.append(float(len(rows)))
+            return out
+        return np.argmax(np.asarray(qnet.forward(rows)), axis=-1)
+
+    chunks = [{k: [] for k in ("frame", "action", "reward", "done",
+                               "boundary")} for _ in range(v)]
+    births: list[list[float]] = [[] for _ in range(v)]
+    ep_rets: list[list[float]] = [[] for _ in range(v)]
+    episodes = [0] * v
+    resets_sent = 0
+
+    def flush(j: int) -> None:
+        nonlocal resets_sent
+        ch = chunks[j]
+        if not ch["action"]:
+            return
+        payload = {
+            "frame": np.stack(ch["frame"]).astype(np.uint8),
+            "action": np.asarray(ch["action"], np.int32),
+            "reward": np.asarray(ch["reward"], np.float32),
+            "done": np.asarray(ch["done"], bool),
+            "boundary": np.asarray(ch["boundary"], bool),
+            "episodes": episodes[j],
+            "ep_returns": np.asarray(ep_rets[j], np.float32),
+        }
+        # process-level telemetry rides whichever stream flushes next
+        # (drain semantics — each sample ships exactly once)
+        payload.update(comms.drain_telemetry())
+        step_ms = venv.drain_step_ms()
+        if step_ms:
+            tick_ms = np.asarray(step_ms, np.float32)
+            payload["tm_vector_step_ms"] = tick_ms
+            # amortized per-env step cost feeds the SAME fleet histogram
+            # the per-env actors populate, so the two modes compare on
+            # one axis
+            payload["tm_env_step_ms"] = tick_ms / v
+        if infer_ms:
+            payload["tm_vector_infer_ms"] = np.asarray(infer_ms, np.float32)
+            infer_ms.clear()
+        if infer_rows:
+            payload["tm_vector_rows"] = np.asarray(infer_rows, np.float32)
+            infer_rows.clear()
+        new_resets = acting.auto_resets - resets_sent
+        if new_resets:
+            payload["tm_vector_resets"] = np.asarray(
+                [new_resets], np.float32)
+            resets_sent = acting.auto_resets
+        if births[j]:
+            if tracing.lineage_sample():
+                payload[tracing.KEY_BIRTH] = tracing.to_server_clock(
+                    np.asarray(births[j], np.float64))
+            births[j].clear()
+        resp = clients[j].add_transitions(**payload)
+        comms.note_published(resp.get("params_version"))
+        for q in ch.values():
+            q.clear()
+        ep_rets[j].clear()
+        episodes[j] = 0
+
+    ticks = 0
+    steps = 0
+    try:
+        while not stop_event.is_set():
+            if max_env_steps and steps >= max_env_steps:
+                break
+            if remote is None:
+                comms.maybe_pull(ticks)
+            else:
+                comms.touch()
+            with tracing.span_sampled("vector_step"):
+                frames, actions, rewards, dones, overs = \
+                    acting.tick(greedy_fn)
+            now = tracing.now() if tracing.ENABLED else 0.0
+            for j in range(v):
+                ch = chunks[j]
+                ch["frame"].append(frames[j])
+                ch["action"].append(int(actions[j]))
+                ch["reward"].append(float(rewards[j]))
+                ch["done"].append(bool(dones[j]))
+                ch["boundary"].append(bool(overs[j]))
+                if tracing.ENABLED:
+                    births[j].append(now)
+                if overs[j]:
+                    episodes[j] += 1
+            for j, ret in acting.drain_completed():
+                ep_rets[j].append(ret)
+            ticks += 1
+            steps += v
+            for j in range(v):
+                if len(chunks[j]["action"]) >= cfg.actors.send_batch:
+                    flush(j)
+        for j in range(v):
+            flush(j)
+    except (ConnectionError, OSError):
+        pass  # learner gone; supervisor owns our lifecycle
+    finally:
+        comms.close()
+        if remote is not None:
+            remote.close()
+        for c in clients:
+            c.close()
+        comms_client.close()
         if tracing.ENABLED:
             tracing.export()
 
@@ -774,7 +995,7 @@ class ActorSupervisor:
                 for i, p in list(self.procs.items()):
                     dead = not p.is_alive()
                     silent = self._is_silent(
-                        now, last_seen.get(i, 0.0),
+                        now, last_seen.get(_liveness_id(self.cfg, i), 0.0),
                         self.spawned_at.get(i, 0.0))
                     if dead or silent:
                         self._reap(p)
@@ -904,6 +1125,15 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     cfg.net.num_actions = probe.num_actions
     obs_shape = probe.obs_shape
     pixel = probe.obs_dtype == np.uint8
+    if int(cfg.actors.vector_envs) > 1 and not pixel:
+        # fail HERE, not in the actor subprocess: VectorActing rejects
+        # non-uint8 frames at construction, and a dead actor fleet
+        # leaves the learner waiting on learn_start forever
+        raise ValueError(
+            "actors.vector_envs > 1 is the pixel acting path (uint8 "
+            f"frames); env {cfg.env.kind}/{cfg.env.id} observes "
+            f"{np.dtype(probe.obs_dtype).name} — use a pixel env or "
+            "vector_envs=1")
     del probe
 
     # β anneal is denominated in sample() calls; this topology samples once
@@ -924,11 +1154,15 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
         cls = (DevicePERFrameReplay
                if cfg.replay.prioritized and cfg.replay.device_per
                else DeviceFrameReplay)
+        # vector mode: every stacked env row is its own replay stream
+        # (slot ownership + flush_seq dedup key on it), so the ring is
+        # built for num_actors * V writers
         replay = cls(
             replay_cfg, solver.mesh, obs_shape, cfg.env.stack,
             cfg.train.gamma, seed=cfg.train.seed,
             write_chunk=cfg.replay.write_chunk,
-            num_streams=cfg.actors.num_actors)
+            num_streams=cfg.actors.num_actors
+            * max(int(cfg.actors.vector_envs), 1))
     elif pixel:
         if cfg.replay.prioritized:
             raise ValueError(
@@ -937,7 +1171,9 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                 "MultiStreamFrameReplay fallback is uniform-only)")
         replay = MultiStreamFrameReplay(
             cfg.replay.capacity, obs_shape, cfg.env.stack, cfg.replay.n_step,
-            cfg.train.gamma, num_streams=cfg.actors.num_actors,
+            cfg.train.gamma,
+            num_streams=cfg.actors.num_actors
+            * max(int(cfg.actors.vector_envs), 1),
             seed=cfg.train.seed)
     else:
         replay = maybe_prioritize(

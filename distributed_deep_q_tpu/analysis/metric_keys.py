@@ -41,7 +41,7 @@ RULE_METRIC = "metric_keys.unknown-metric"
 RULE_SPAN = "metric_keys.unknown-span"
 
 NAMESPACES = ("rpc", "fleet", "queue", "durability", "flow", "trace",
-              "learner", "ingest", "inference", "shard")
+              "learner", "ingest", "inference", "shard", "actor")
 _NS_RE = re.compile(r"^(?:%s)/.+" % "|".join(NAMESPACES))
 
 EMITTERS = frozenset(
@@ -116,6 +116,13 @@ REGISTRY = frozenset({
     "shard/rows",
     "shard/ingest_rate",
     "shard/owner_host",
+    # vectorized acting plane (ISSUE 11): histogram prefixes fed by the
+    # vector actor's tm_* payload keys — whole-tick batched step ms,
+    # batched-infer round trip + rows per RPC, auto-resets per flush
+    "actor/vector_step_ms",
+    "actor/infer_rtt_ms",
+    "actor/vector_rows",
+    "actor/auto_resets",
 })
 
 _TRACING_REL = os.path.join("distributed_deep_q_tpu", "tracing.py")

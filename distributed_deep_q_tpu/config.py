@@ -216,10 +216,29 @@ class ActorConfig:
     # restarting actor keeps its host, host join/leave remaps only
     # ~fleet/hosts actors, and a host address change is just a reconnect
     assignment: str = "contiguous"
+    # Sebulba-style vectorized acting (actors/vector.py): >1 makes each
+    # actor PROCESS drive this many stacked env copies behind one
+    # batched step — V global actor identities (ε ladder slots, env
+    # seeds, replay streams) per process, one infer RPC per wall tick.
+    # 0/1 = the historical one-env-per-process loop. Replay stream ids
+    # become process_id*V + row, so device replays must be built with
+    # num_streams = num_actors * V (train_distributed does this).
+    vector_envs: int = 0
     # explicit local→global actor id map, filled in by the supervisor's
     # fleet split under assignment="hash" (local slot i plays global
     # actor actor_gids[i]). Empty = derive gid as actor_id + offset
     actor_gids: tuple[int, ...] = ()
+    # Anakin mode (parallel/anakin.py): >0 runs acting INSIDE the jitted
+    # learner program — this many jax envs (ops/jax_envs.py, must divide
+    # over the dp mesh; 0 = mode off) co-resident with training, one
+    # device sub-ring per env, zero steady-state host transfers. An
+    # explicit opt-in, not inferred: only the signal_atari family has a
+    # JAX-expressible step
+    anakin_envs: int = 0
+    # env ticks per Anakin superstep (must stay ≤ the ring's slot_cap so
+    # one insert never wraps a sub-ring — the same single-flush-chunk
+    # invariant the host write path keeps)
+    anakin_ticks: int = 16
     # Ape-X ε ladder: actor i uses ε = base ** (1 + i/(N-1) * alpha) [T]
     eps_base: float = 0.4
     eps_alpha: float = 7.0

@@ -1,0 +1,149 @@
+"""JAX ports of the synthetic pixel envs — the Anakin acting substrate.
+
+``actors/game.py``'s ``SignalAtari`` / ``VelocitySignalAtari`` step
+functions re-expressed in ``jax.numpy`` so acting can live INSIDE the
+jitted program (Podracer's Anakin endpoint, PAPERS.md arXiv:2104.06272):
+vmapped over N envs, scanned over T ticks, zero host round-trips.
+
+Semantics match the numpy envs op-for-op — background 20 / band 220,
+reward keyed on the PRE-step target, the returned frame rendered from
+the POST-step target, auto-reset folded into ``step`` (the numpy fleet's
+caller does step-then-reset; here ``done`` selects the reset branch in
+the same tick). The RNG is the one deliberate difference: numpy's Philox
+``Generator`` cannot be reproduced bitwise with ``jax.random``, so each
+env carries its own JAX key and the port defines its OWN deterministic
+stream — the Anakin-vs-host-loop pin compares two drivers of THESE envs,
+not numpy vs jax.
+
+Every env is a ``(reset_fn, step_fn)`` pair over a dict-of-arrays state:
+``reset_fn(key) -> (state, frame)``,
+``step_fn(state, action) -> (state, frame, reward, done)``
+with u8 frames, f32 rewards, bool dones — vmap over a leading key/state
+axis for the stacked fleet.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _band_frame(frame_shape, orientation: str, lo, width):
+    """[H, W] u8 frame: background 20, one band of 220 covering axis
+    positions ``[lo, lo+width)`` (mod axis length) — vertical bands are
+    column ranges, horizontal are row ranges."""
+    h, w = frame_shape
+    axis = w if orientation == "v" else h
+    pos = jnp.arange(axis, dtype=jnp.int32)
+    mask = ((pos - lo) % axis) < width
+    frame = jnp.where(mask, jnp.uint8(220), jnp.uint8(20))
+    if orientation == "v":
+        return jnp.broadcast_to(frame[None, :], (h, w))
+    return jnp.broadcast_to(frame[:, None], (h, w))
+
+
+def make_signal_env(frame_shape=(84, 84), num_actions: int = 4,
+                    episode_len: int = 32, orientation: str = "v"):
+    """JAX ``SignalAtari``: static band at ``target * band_width``; the
+    target redraws EVERY step, so reward demands reading the current
+    frame (no constant policy scores above chance)."""
+    h, w = frame_shape
+    axis = w if orientation == "v" else h
+    band = max(axis // num_actions, 1)
+
+    def render(target):
+        return _band_frame(frame_shape, orientation, target * band, band)
+
+    def reset_fn(key):
+        key, kt = jax.random.split(key)
+        target = jax.random.randint(kt, (), 0, num_actions, jnp.int32)
+        state = {"t": jnp.int32(0), "target": target, "key": key}
+        return state, render(target)
+
+    def step_fn(state, action):
+        key, k_step, k_reset = jax.random.split(state["key"], 3)
+        reward = (action == state["target"]).astype(jnp.float32)
+        t = state["t"] + 1
+        done = t >= episode_len
+        # the numpy caller does step (one draw) then, on done, reset
+        # (another draw); both draws happen here and done selects
+        target = jnp.where(
+            done,
+            jax.random.randint(k_reset, (), 0, num_actions, jnp.int32),
+            jax.random.randint(k_step, (), 0, num_actions, jnp.int32))
+        t = jnp.where(done, jnp.int32(0), t)
+        state = {"t": t, "target": target, "key": key}
+        return state, render(target), reward, done
+
+    return reset_fn, step_fn
+
+
+def make_velocity_signal_env(frame_shape=(84, 84), num_actions: int = 4,
+                             episode_len: int = 32,
+                             orientation: str = "v", segment: int = 8):
+    """JAX ``VelocitySignalAtari``: a band MOVES at one of ``num_actions``
+    signed velocities; the velocity index is the correct action, so the
+    policy must integrate ≥2 frames. ``segment=0`` holds the velocity
+    for the whole episode (the memory-gate tier)."""
+    h, w = frame_shape
+    axis = w if orientation == "v" else h
+    seg = int(segment) if segment else episode_len + 1
+    band_width = max(3, axis // 8)
+    unit = max(2, axis // 16)
+    half = num_actions // 2
+    units = list(range(-half, 0)) + list(range(1, num_actions - half + 1))
+    velocities = jnp.asarray([unit * m for m in units], jnp.int32)
+
+    def render(pos):
+        return _band_frame(frame_shape, orientation, pos, band_width)
+
+    def _redraw(kv, kp):
+        # numpy order: velocity index first, then position
+        return (jax.random.randint(kv, (), 0, num_actions, jnp.int32),
+                jax.random.randint(kp, (), 0, axis, jnp.int32))
+
+    def reset_fn(key):
+        key, kv, kp = jax.random.split(key, 3)
+        v_idx, pos = _redraw(kv, kp)
+        state = {"t": jnp.int32(0), "v_idx": v_idx, "pos": pos, "key": key}
+        return state, render(pos)
+
+    def step_fn(state, action):
+        key, kv1, kp1, kv2, kp2 = jax.random.split(state["key"], 5)
+        reward = (action == state["v_idx"]).astype(jnp.float32)
+        t = state["t"] + 1
+        redraw = (t % seg) == 0
+        v_draw, p_draw = _redraw(kv1, kp1)
+        advanced = (state["pos"] + velocities[state["v_idx"]]) % axis
+        v_idx = jnp.where(redraw, v_draw, state["v_idx"])
+        pos = jnp.where(redraw, p_draw, advanced)
+        done = t >= episode_len
+        v_reset, p_reset = _redraw(kv2, kp2)
+        v_idx = jnp.where(done, v_reset, v_idx)
+        pos = jnp.where(done, p_reset, pos)
+        t = jnp.where(done, jnp.int32(0), t)
+        state = {"t": t, "v_idx": v_idx, "pos": pos, "key": key}
+        return state, render(pos), reward, done
+
+    return reset_fn, step_fn
+
+
+def make_jax_env(cfg):
+    """``make_env``'s dispatch for the JAX-expressible kinds.
+
+    ``cfg`` is an ``EnvConfig`` with ``kind == "signal_atari"``; id
+    suffixes select exactly as the numpy dispatcher does ("-h"
+    horizontal, "-vel" velocity, "-ep" whole-episode velocity hold).
+    """
+    if cfg.kind != "signal_atari":
+        raise ValueError(
+            f"no JAX port for env kind {cfg.kind!r} — Anakin covers the "
+            "signal_atari family; other envs act through the vectorized "
+            "or per-env host loops")
+    orientation = "h" if cfg.id.endswith("-h") else "v"
+    if "-vel" in cfg.id:
+        return make_velocity_signal_env(
+            frame_shape=tuple(cfg.frame_shape), orientation=orientation,
+            segment=0 if "-ep" in cfg.id else 8)
+    return make_signal_env(frame_shape=tuple(cfg.frame_shape),
+                           orientation=orientation)

@@ -63,6 +63,12 @@ class ServerTelemetry:
         "tm_param_pull_ms": "fleet/param_pull_ms",
         "tm_heartbeat_rtt_ms": "fleet/heartbeat_rtt_ms",
         "tm_env_step_ms": "fleet/env_step_ms",
+        # vectorized acting plane (ISSUE 11): whole-tick batched env
+        # step, batched-infer round trip + rows per RPC, auto-resets
+        "tm_vector_step_ms": "actor/vector_step_ms",
+        "tm_vector_infer_ms": "actor/infer_rtt_ms",
+        "tm_vector_rows": "actor/vector_rows",
+        "tm_vector_resets": "actor/auto_resets",
     }
 
     def __init__(self) -> None:

@@ -74,6 +74,9 @@ STAGES = (
     "infer_batch",     # microbatch cut: stack + pad to a compiled bucket
     "infer_forward",   # the ONE device-resident jit'd policy forward
     "remote_infer",    # actor-side infer round trip (obs out, action back)
+    "vector_step",     # one vectorized actor tick (N actions + batched step)
+    "vector_infer",    # vector actor's batched infer round trip (one RPC)
+    "anakin_superstep",  # fully-jitted act+insert+train dispatch (host side)
     "snapshot_capture",  # durability: state capture under locks
     "snapshot_write",  # durability: serialize + atomic write (off-lock)
     "restore",         # durability: warm-boot generation walk

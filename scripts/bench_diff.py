@@ -61,7 +61,7 @@ _SKIP = ("_chain_k", "_vs_", "vs_baseline", "ring_capacity",
          "flagship_batch", "concurrent_writers", "peak_flops", "n", "rc",
          "flops_per_step", "max_in_flight_rows", "inference_slo_ms",
          "inference_max_batch", "inference_cutoff_us", "sheds",
-         "local_actions_per_s", "n_hosts", "dispatch_k")
+         "local_actions_per_s", "n_hosts", "dispatch_k", "n_envs")
 
 
 def _parsed(path: str) -> dict:

@@ -1,6 +1,6 @@
 """Chaos smoke — prove the RPC fault-tolerance stack end to end.
 
-Six modes:
+Seven modes:
 
 ``python scripts/chaos_smoke.py [num_actors] [spec]`` (default)
     Threaded actor fleet over the production wire protocol: resilient
@@ -42,6 +42,18 @@ Six modes:
     zero duplicated actions despite reconnects and shed/retry cycles
     (``infer`` is pure in (θ, obs), so retries need no dedup; a wrong
     action would mean a slicing/padding/batching bug under fault load).
+
+``python scripts/chaos_smoke.py vector [spec]``
+    Vector-actor acceptance (ISSUE 11): the vectorized acting loop's
+    ε-greedy tick (``select_actions`` over labeled observation batches)
+    drives the production ``_RemoteInference`` retry path while the
+    chaos shim drops/truncates the wire AND the inference server is
+    hard-killed mid-run, then rebooted with the same θ on the same
+    port. The gate: the loop rode out the outage through shed/retry
+    with zero wrong, zero duplicated, and zero missing actions — every
+    tick's action vector matches a local same-seed oracle replay of the
+    identical ε-stream, so the greedy-subset batching (only non-explore
+    rows ride the RPC) never crossed rows under fault load.
 
 ``python scripts/chaos_smoke.py durability [cycles] [spec]``
     Crash-recovery acceptance (ISSUE 6): the server is hard-killed at
@@ -619,6 +631,164 @@ def run_inference_chaos_smoke(
     return verdict
 
 
+def run_vector_chaos_smoke(
+        num_envs: int = 8, ticks: int = 60,
+        spec: str = "drop=0.02,truncate=0.01,seed=31",
+        deadline: float = 120.0) -> dict:
+    """Vectorized actor vs a dying inference server (ISSUE 11).
+
+    One vector acting loop — the production ``select_actions`` ε-split
+    over the production ``_RemoteInference`` stub — ticks labeled
+    observation batches (deterministic in ``(tick, row)``) while wire
+    chaos drops/truncates connections and, at the half-way tick, the
+    ``InferenceServer`` is hard-killed and then rebooted with the SAME
+    seed θ on the SAME port. Because ``infer`` is pure in (θ, obs) and θ
+    survives the reboot, every action has exactly one right answer, so
+    the oracle is a same-seed local replay: fresh rngs with the run's
+    seeds re-consume the identical ε-stream against a bucket-(1,)
+    ``BatchedPolicy``, and any divergence — a crossed row in the greedy
+    subset, a stale retry landing on the wrong tick, an ε draw consumed
+    twice — shows up as a wrong action, exactly.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_deep_q_tpu.actors.supervisor import (
+        _RemoteInference, actor_epsilon)
+    from distributed_deep_q_tpu.actors.vector import select_actions
+    from distributed_deep_q_tpu.config import Config, NetConfig
+    from distributed_deep_q_tpu.models.policy import BatchedPolicy
+    from distributed_deep_q_tpu.rpc import faultinject
+    from distributed_deep_q_tpu.rpc.inference_server import InferenceServer
+
+    trc = _trace_begin()
+    plan = faultinject.install(spec) if spec else None
+    hw, stack, n_act = (10, 10), 2, 4
+    net = NetConfig(kind="mlp", hidden=(32, 32), num_actions=n_act,
+                    frame_shape=hw, stack=stack)
+    obs_dim = hw[0] * hw[1] * stack
+    cfg = Config()
+    cfg.net = net
+    cfg.inference.enabled = True
+    # tight backoff so the mid-run outage is ridden out in milliseconds,
+    # not the production half-second ladder
+    cfg.actors.rpc_retry_base = 0.01
+    cfg.actors.rpc_retry_max = 0.2
+    cfg.actors.rpc_retry_deadline = deadline
+
+    def build_server():
+        # SAME seed every boot: θ is identical across the kill, which is
+        # what makes "wrong action" decidable through the reboot
+        pol = BatchedPolicy(net, seed=7, obs_dim=obs_dim,
+                            buckets=cfg.inference.buckets)
+        return InferenceServer(pol, host=cfg.inference.host,
+                               port=cfg.inference.port,
+                               max_batch=cfg.inference.max_batch,
+                               cutoff_us=cfg.inference.cutoff_us)
+
+    server = build_server()
+    cfg.inference.host, cfg.inference.port = server.address
+    stop = threading.Event()
+    remote = _RemoteInference(cfg, stop, actor_id=0, gid=0)
+
+    def make_obs(t: int) -> np.ndarray:
+        # labeled: the batch IS its identity — one deterministic uint8
+        # frame stack per (tick, row), the vector loop's exact obs shape
+        rows = [np.random.default_rng(1_000 * (t + 1) + j)
+                .integers(0, 256, hw + (stack,)).astype(np.uint8)
+                for j in range(num_envs)]
+        return np.stack(rows)
+
+    def make_rngs():
+        return [np.random.default_rng(7777 * (j + 1))
+                for j in range(num_envs)]
+
+    epsilons = [actor_epsilon(j, num_envs, 0.4, 7.0)
+                for j in range(num_envs)]
+    got: dict[int, np.ndarray] = {}
+    errors: list[str] = []
+    duplicated = [0]
+    progress = [0]
+
+    def loop() -> None:
+        rngs = make_rngs()
+        try:
+            for t in range(ticks):
+                acts = select_actions(make_obs(t), rngs, epsilons, n_act,
+                                      remote.actions)
+                if t in got:
+                    duplicated[0] += 1
+                got[t] = acts
+                progress[0] = t + 1
+        except Exception as e:  # noqa: BLE001 — reported in the verdict
+            errors.append(f"vector loop: {type(e).__name__}: {e}")
+
+    th = threading.Thread(target=loop, daemon=True)
+    t0 = time.perf_counter()
+    th.start()
+    # hard-kill the inference plane mid-run; the loop must shed/retry
+    # through the outage, never skip or re-order a tick
+    t_end = time.monotonic() + deadline / 2
+    while progress[0] < ticks // 2 and time.monotonic() < t_end:
+        time.sleep(0.005)
+    kill_tick = progress[0]
+    server.close()
+    time.sleep(0.2)  # let in-flight calls hit the dead port
+    server = build_server()  # same seed, same host:port — warm reboot
+    th.join(timeout=deadline)
+    hung = int(th.is_alive())
+    stop.set()
+    wall = time.perf_counter() - t0
+    tm = server.telemetry_summary()
+    remote.close()
+    server.close()
+    if plan:
+        faultinject.uninstall()
+
+    # oracle AFTER the run: replay the identical ε-stream against the
+    # canonical bucket-(1,) local forward and demand bitwise agreement
+    oracle = BatchedPolicy(net, seed=7, obs_dim=obs_dim, buckets=(1,))
+    orngs = make_rngs()
+    wrong = missing = 0
+    for t in range(ticks):
+        want = select_actions(make_obs(t), orngs, epsilons, n_act,
+                              lambda rows: oracle.forward(rows)[0])
+        if t not in got:
+            missing += num_envs
+            continue
+        wrong += int(np.sum(got[t] != want))
+    trace = _trace_verdict(trc)
+    # the outage must be VISIBLE in the causal record: the resilient
+    # stub's retry cycles and/or reconnects, plus any shed instants
+    retry_events = (trace["instants"].get("retry", 0)
+                    + trace["instants"].get("reconnect", 0))
+    verdict = {
+        "ok": (not errors and not hung and wrong == 0 and missing == 0
+               and duplicated[0] == 0 and retry_events > 0
+               and trace["orphan_spans"] == 0
+               and (remote.sheds == 0
+                    or trace["instants"].get("shed", 0) > 0)),
+        "num_envs": num_envs,
+        "ticks": ticks,
+        "actions_checked": ticks * num_envs,
+        "wrong_actions": wrong,
+        "missing_actions": missing,
+        "duplicated_ticks": duplicated[0],
+        "kill_tick": kill_tick,
+        "client_sheds": remote.sheds,
+        "retry_events": retry_events,
+        "reboot_server_requests": tm.get("inference/requests", 0),
+        "chaos_spec": spec,
+        "faults_fired": dict(sorted(plan.counters.items())) if plan else {},
+        "hung": hung,
+        "errors": errors,
+        "wall_s": round(wall, 2),
+        "trace": trace,
+    }
+    return verdict
+
+
 def run_durability_smoke(cycles: int = 20, num_actors: int = 3,
                          flushes_per_cycle: int = 4, rows: int = 8,
                          spec: str = "torn=0.35,corrupt=0.03,seed=23",
@@ -840,6 +1010,12 @@ if __name__ == "__main__":
         if len(args) > 2:
             kwargs["spec"] = args[2]
         verdict = run_durability_smoke(**kwargs)
+        print(json.dumps(verdict))
+        sys.exit(0 if verdict["ok"] else 1)
+    if args and args[0] in ("vector", "--vector"):
+        verdict = run_vector_chaos_smoke(
+            spec=args[1] if len(args) > 1
+            else "drop=0.02,truncate=0.01,seed=31")
         print(json.dumps(verdict))
         sys.exit(0 if verdict["ok"] else 1)
     if args and args[0] in ("inference", "--inference"):

@@ -1,0 +1,192 @@
+"""Vectorized acting plane (ISSUE 11): bitwise-parity guarantees.
+
+The whole value of ``actors/vector.py`` rests on one claim: stacking N
+envs behind one batched step changes THROUGHPUT, never TRAJECTORIES.
+These tests pin that claim at three layers — raw env stepping (all four
+synthetic env kinds, across auto-reset boundaries), the batched frame
+stacker, and the full acting tick (ε-greedy + batched forward) against
+N independent sequential actors on both torsos.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_deep_q_tpu.actors.game import (
+    FrameStacker, make_env, make_envs)
+from distributed_deep_q_tpu.actors.vector import (
+    VectorActing, VectorEnv, VectorFrameStacker, VectorStepLatencyEnv)
+from distributed_deep_q_tpu.actors.supervisor import actor_epsilon
+from distributed_deep_q_tpu.config import EnvConfig, NetConfig, env_for_actor
+
+SEEDS = [5, 6, 7]
+
+
+def _env_cfg(env_id: str, kind: str) -> EnvConfig:
+    return EnvConfig(id=env_id, kind=kind, frame_shape=(10, 10), stack=2)
+
+
+@pytest.mark.parametrize("env_id,kind", [
+    ("fake", "fake_atari"),
+    ("signal", "signal_atari"),
+    ("signal-h", "signal_atari"),
+    ("signal-vel", "signal_atari"),
+])
+def test_vector_env_bitwise_parity(env_id, kind):
+    """VectorEnv == N sequential envs, frame-for-frame, across episode
+    boundaries (auto-reset rows must return the NEW episode's first
+    frame, exactly what env.reset() after the step would)."""
+    cfg = _env_cfg(env_id, kind)
+    venv = VectorEnv(make_envs(cfg, SEEDS))
+    singles = make_envs(cfg, SEEDS)
+    arng = np.random.default_rng(0)
+    np.testing.assert_array_equal(
+        venv.reset(), np.stack([e.reset() for e in singles]))
+    overs_seen = 0
+    for _ in range(75):  # episode_len is 10 (fake) / 32 (signal): crosses
+        acts = arng.integers(venv.num_actions, size=len(SEEDS))
+        fv, rv, dv, ov = venv.step(acts)
+        for j, env in enumerate(singles):
+            f, r, d, o = env.step(int(acts[j]))
+            if o:
+                f = env.reset()
+            np.testing.assert_array_equal(fv[j], f)
+            assert rv[j] == np.float32(r)
+            assert bool(dv[j]) == bool(d) and bool(ov[j]) == bool(o)
+        overs_seen += int(ov.sum())
+    assert overs_seen > 0, "no auto-reset boundary was exercised"
+
+
+def test_vector_frame_stacker_rows_match_per_env():
+    rng = np.random.default_rng(3)
+    n, shape, stack = 3, (6, 6), 4
+    vec = VectorFrameStacker(n, shape, stack)
+    singles = [FrameStacker(shape, stack) for _ in range(n)]
+    frames = rng.integers(0, 256, (n,) + shape, dtype=np.uint8)
+    np.testing.assert_array_equal(
+        vec.reset(frames), np.stack([s.reset(frames[j])
+                                     for j, s in enumerate(singles)]))
+    for t in range(9):
+        frames = rng.integers(0, 256, (n,) + shape, dtype=np.uint8)
+        out = vec.push(frames)
+        for j, s in enumerate(singles):
+            np.testing.assert_array_equal(out[j], s.push(frames[j]))
+        if t == 4:  # mid-stream per-row reset (episode boundary)
+            f = rng.integers(0, 256, shape, dtype=np.uint8)
+            vec.reset_row(1, f)
+            singles[1].reset(f)
+            np.testing.assert_array_equal(vec.obs[1], singles[1].obs)
+
+
+def test_vector_latency_wrapper_times_whole_tick_and_passes_through():
+    cfg = _env_cfg("signal", "signal_atari")
+    venv = VectorStepLatencyEnv(VectorEnv(make_envs(cfg, SEEDS)))
+    assert venv.num_envs == len(SEEDS)          # __getattr__ passthrough
+    assert venv.num_actions == 4
+    venv.reset()
+    venv.step(np.zeros(len(SEEDS), np.int64))
+    ms = venv.drain_step_ms()
+    assert len(ms) == 1 and ms[0] > 0.0         # one sample per TICK
+    assert venv.drain_step_ms() == []
+
+
+def _sequential_rollout(env_cfg, gid, train_seed, fleet, qnet, greedy,
+                        ticks):
+    """The single-env actor loop's exact transition semantics (pre-step
+    frame appended, post-step frame discarded on episode end) with the
+    fleet's exact seeding discipline."""
+    env = make_env(env_for_actor(env_cfg, gid),
+                   seed=train_seed + 1000 * (gid + 1))
+    rng = np.random.default_rng(train_seed + 7777 * (gid + 1))
+    eps = actor_epsilon(gid, fleet, 0.4, 7.0)
+    stacker = FrameStacker(env.obs_shape, env_cfg.stack)
+    frame = env.reset()
+    obs = stacker.reset(frame)
+    rec = {k: [] for k in ("frame", "action", "reward", "done", "boundary")}
+    for _ in range(ticks):
+        if rng.random() < eps:
+            a = int(rng.integers(env.num_actions))
+        else:
+            a = greedy(np.asarray(obs))
+        nf, r, d, o = env.step(a)
+        rec["frame"].append(frame)
+        rec["action"].append(a)
+        rec["reward"].append(np.float32(r))
+        rec["done"].append(bool(d))
+        rec["boundary"].append(bool(o))
+        frame = nf
+        obs = stacker.push(frame)
+        if o:
+            frame = env.reset()
+            obs = stacker.reset(frame)
+    return rec
+
+
+@pytest.mark.parametrize("kind,frame_shape", [
+    ("mlp", (10, 10)),
+    ("nature_cnn", (36, 36)),   # smallest shape the VALID conv stack takes
+])
+def test_vector_acting_matches_sequential_actors(kind, frame_shape):
+    """The acceptance pin: same seeds → same actions → same transitions,
+    vector tick vs N independent per-env actor loops, on both torsos."""
+    from distributed_deep_q_tpu.models.qnet import QNet
+
+    train_seed, n, ticks = 11, 3, 40
+    env_cfg = EnvConfig(id="signal", kind="signal_atari",
+                        frame_shape=frame_shape, stack=2)
+    net_cfg = NetConfig(kind=kind, num_actions=4, hidden=(32, 32),
+                        frame_shape=frame_shape, stack=2)
+    obs_dim = int(np.prod(frame_shape)) * 2
+    qnet = QNet(net_cfg, seed=train_seed, obs_dim=obs_dim)
+
+    gids = list(range(n))
+    fleet = n
+    venv = VectorEnv(make_envs(
+        [env_for_actor(env_cfg, g) for g in gids],
+        [train_seed + 1000 * (g + 1) for g in gids]))
+    rngs = [np.random.default_rng(train_seed + 7777 * (g + 1))
+            for g in gids]
+    eps = [actor_epsilon(g, fleet, 0.4, 7.0) for g in gids]
+    acting = VectorActing(venv, env_cfg.stack, rngs, eps)
+
+    def batched_greedy(rows):
+        return np.argmax(np.asarray(qnet.forward(rows)), axis=-1)
+
+    vec = [{k: [] for k in ("frame", "action", "reward", "done",
+                            "boundary")} for _ in range(n)]
+    for _ in range(ticks):
+        frames, actions, rewards, dones, overs = acting.tick(batched_greedy)
+        for j in range(n):
+            vec[j]["frame"].append(frames[j])
+            vec[j]["action"].append(int(actions[j]))
+            vec[j]["reward"].append(np.float32(rewards[j]))
+            vec[j]["done"].append(bool(dones[j]))
+            vec[j]["boundary"].append(bool(overs[j]))
+    assert acting.auto_resets > 0, "no episode boundary was exercised"
+
+    def single_greedy(obs):
+        return int(np.argmax(np.asarray(qnet.forward(obs[None]))[0]))
+
+    for j, g in enumerate(gids):
+        ref = _sequential_rollout(env_cfg, g, train_seed, fleet, qnet,
+                                  single_greedy, ticks)
+        assert vec[j]["action"] == ref["action"]
+        np.testing.assert_array_equal(np.stack(vec[j]["frame"]),
+                                      np.stack(ref["frame"]))
+        np.testing.assert_array_equal(np.asarray(vec[j]["reward"]),
+                                      np.asarray(ref["reward"]))
+        assert vec[j]["done"] == ref["done"]
+        assert vec[j]["boundary"] == ref["boundary"]
+
+
+def test_vector_mode_rejects_non_pixel_env_before_spawning():
+    # the misconfiguration path: VectorActing rejects float32 obs at
+    # construction, but that happens inside the ACTOR subprocess — the
+    # learner would then sit at learn_start forever. train_distributed
+    # must reject the config up front, before any process spawns.
+    from distributed_deep_q_tpu.actors.supervisor import train_distributed
+    from distributed_deep_q_tpu.config import cartpole_config
+
+    cfg = cartpole_config()
+    cfg.actors.vector_envs = 4
+    with pytest.raises(ValueError, match="pixel acting path"):
+        train_distributed(cfg)
