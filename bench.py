@@ -173,14 +173,12 @@ INGEST_TARGET = 1_024
 # both (VERDICT r4 weak #2 / next #5).
 REP_TARGET_S = 3.0
 
-# bf16 peak FLOP/s by device_kind prefix (public spec sheets)
-PEAK_FLOPS = {
-    "TPU v6 lite": 918e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5": 459e12,      # v5p
-    "TPU v4": 275e12,
-    "TPU v3": 123e12,      # per chip (2 cores)
-}
+# flops census (PEAK_FLOPS / peak_flops_for / xla_flops /
+# fused_train_flops) now lives in distributed_deep_q_tpu/profiling.py —
+# promoted so the supervisor's LIVE train/mfu gauge and this bench's
+# offline derivation share one source of truth (ISSUE 13)
+from distributed_deep_q_tpu.profiling import (  # noqa: E402
+    MFUMeter, PEAK_FLOPS, fused_train_flops, peak_flops_for, xla_flops)
 
 
 def analytic_flops_per_step(batch: int) -> float:
@@ -192,62 +190,6 @@ def analytic_flops_per_step(batch: int) -> float:
            + 2 * 512 * 8)                      # dueling heads (~A+1 outs)
     # online fwd+bwd ~= 3x fwd; + target fwd + double-DQN online fwd on s'
     return 5.0 * fwd * batch
-
-
-def peak_flops_for(device) -> float | None:
-    kind = getattr(device, "device_kind", "")
-    for prefix, peak in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
-        if kind.startswith(prefix):
-            return peak
-    return None
-
-
-def xla_flops(solver, replay, batch) -> float | None:
-    """FLOPs of the compiled ring train step, from XLA's cost model."""
-    try:
-        fn = solver.learner._ring_steps[tuple(solver.config.net.frame_shape)]
-        clean = {k: v for k, v in batch.items()
-                 if k not in ("index", "_sampled_at")}
-        cost = fn.lower(solver.state, replay.ring, clean).compile() \
-                 .cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0))
-        return flops if flops > 0 else None
-    except Exception:
-        return None
-
-
-def fused_train_flops(solver, replay, chain: int) -> float | None:
-    """Per-grad-step FLOPs of the FUSED train program — the same program
-    the MFU denominator times (ADVICE r4: the r4 numerator came from the
-    uniform ring step, a cross-program mismatch). XLA's cost model counts
-    a ``lax.scan`` body ONCE (verified against the analytic count: the
-    batch-512 chained program reports ~44.8 GF regardless of chain), so
-    the figure is already per-step."""
-    try:
-        import jax
-
-        sample, train = solver.learner._device_per_steps[
-            (solver._dp_spec, chain)]
-        cursors, sizes = replay.device_inputs()
-        betas = np.full(chain, 0.5, np.float32)
-        keys = np.zeros((replay.num_shards, chain, 2), np.uint32)
-        rows = replay.dstate
-        # eval_shape: the lowering only needs avals — no device sample
-        # execution, no sampling-key-stream side effect
-        metas, win, idx = jax.eval_shape(
-            sample, keys, rows.frames, rows.action, rows.reward,
-            rows.done, rows.boundary, rows.prio, np.asarray(cursors),
-            np.asarray(sizes), betas)
-        cost = train.lower(solver.state, metas, win, idx, rows.prio,
-                           rows.maxp).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0))
-        return flops if flops > 0 else None
-    except Exception:
-        return None
 
 
 def fused_train_census(solver, replay, chain) -> dict | None:
@@ -1170,6 +1112,63 @@ def _multihost_curve(note) -> dict:
     return curve
 
 
+def _health_overhead(reps: int = 5, iters: int = 2000) -> dict:
+    """Measured cost of the health plane's hot calls (PERF.md §15):
+    one monitor ``sample`` of a realistic gauge dict + latency-histogram
+    snapshot, one ``verdict`` evaluation over populated rings, and the
+    disabled-path no-op. Median-of-reps µs per call;
+    ``health_spread`` = (max−min)/median of the sample timings."""
+    from distributed_deep_q_tpu import health
+    from distributed_deep_q_tpu.metrics import Histogram
+
+    health.configure(enabled=True)
+    try:
+        mon = health.HealthMonitor(
+            rules=health.default_server_rules(),
+            trends=health.default_server_trends())
+        # the shape a real scrape carries: ~40 scalar gauges (most
+        # unwatched — the common case the watch cache must keep cheap)
+        # + one cumulative latency histogram snapshot per tick
+        gauges = {"rpc/" + f"m{i}_calls": float(i) for i in range(30)}
+        gauges.update({"rpc/checksum_errors": 0.0,
+                       "flow/credit_starvation": 0.1,
+                       "flow/ingest_rate": 900.0,
+                       "queue/staged_rows": 100.0})
+        hist = Histogram()
+        hist.observe_many(np.random.default_rng(0).lognormal(1, 1, 512))
+
+        def one_rep(fn, n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                fn()
+            return 1e6 * (time.perf_counter() - t0) / n
+
+        tick = [0.0]
+
+        def sample_once():
+            tick[0] += 1.0
+            mon.sample(gauges,
+                       {"rpc/add_transitions_ms": hist.snapshot()},
+                       t=tick[0])
+
+        sample_us = [one_rep(sample_once, iters) for _ in range(reps)]
+        verdict_us = [one_rep(lambda: mon.verdict(t=tick[0]), iters)
+                      for _ in range(reps)]
+        health.disable()
+        noop_us = [one_rep(lambda: mon.sample(gauges), iters)
+                   for _ in range(reps)]
+        med = float(np.median(sample_us))
+        return {
+            "health_sample_us": round(med, 2),
+            "health_verdict_us": round(float(np.median(verdict_us)), 2),
+            "health_disabled_us": round(float(np.median(noop_us)), 3),
+            "health_spread": round(
+                (max(sample_us) - min(sample_us)) / med, 4),
+        }
+    finally:
+        health.reset()
+
+
 def main() -> None:
     import jax
 
@@ -1452,6 +1451,10 @@ def main() -> None:
     out["multihost_linearity_4x_spread"] = round(
         mh["1"]["spread"] + mh["4"]["spread"], 4)
 
+    note("health_overhead")
+    # -- health plane overhead (ISSUE 13, PERF.md §15) --------------------
+    out.update(_health_overhead(iters=200 if on_cpu else 2000))
+
     # -- derived ----------------------------------------------------------
     # spread discipline (VERDICT r4 next #5): chained keys must hold
     # spread <= 0.1; PER-DISPATCH keys cannot — their rate IS the shared
@@ -1492,9 +1495,27 @@ def main() -> None:
                                     / 1e12, 2)
         out["mfu"] = (round(out["flops_per_step"] * in_scan_rate / peak, 4)
                       if peak else None)
+        # live train/mfu (ISSUE 13): the SAME in-scan window fed through
+        # the runtime MFUMeter the supervisor logs from — same flops
+        # census, same peak, only the rate plumbing differs — asserted
+        # against the offline derivation on the flagship row. The meter
+        # rounds steps/s to 1e-3 and mfu to 1e-4; 2% covers both
+        # roundings with margin. No published peak (CPU container) →
+        # both sides are None: recorded, not asserted.
+        meter = MFUMeter(out["flops_per_step"], peak)
+        meter.update(0, t=0.0)  # opens the window
+        live = meter.update(10_000, t=10_000 / in_scan_rate)
+        out["mfu_live"] = live.get("train/mfu")
+        out["mfu_live_tolerance"] = 0.02
+        if out["mfu"]:
+            rel = abs(out["mfu_live"] - out["mfu"]) / out["mfu"]
+            assert rel <= out["mfu_live_tolerance"], (
+                f"live train/mfu {out['mfu_live']} deviates {rel:.2%} "
+                f"from the offline derivation {out['mfu']}")
     else:
         out["tflops_per_s"] = None
         out["mfu"] = None
+        out["mfu_live"] = None
     out["vs_baseline_grad_steps"] = round(flagship / CAFFE_STEPS_PER_S, 2)
 
     line = {

@@ -26,7 +26,7 @@ from typing import Any
 
 import numpy as np
 
-from distributed_deep_q_tpu import tracing
+from distributed_deep_q_tpu import health, tracing
 from distributed_deep_q_tpu.config import Config
 from distributed_deep_q_tpu.metrics import Metrics
 
@@ -1080,6 +1080,52 @@ def _publish_weights(server, infer_server, weights) -> None:
         infer_server.set_params(weights, version=version)
 
 
+def _bring_up_health_plane(cfg: Config, server, infer_server=None,
+                           solver=None, replay=None, fused: bool = False):
+    """Fleet health aggregator + live MFU meter (ISSUE 13).
+
+    Every RPC-plane member's ``health_scrape`` registers with ONE
+    ``FleetHealth`` — both servers live in the learner process, so the
+    scrape is an in-process call (a remote member would register its
+    client stub's ``.health`` instead; same wire dict either way). The
+    MFU meter gets a flops-per-step census only on the fused device-PER
+    path (the flagship program bench's offline MFU times) and only when
+    the health plane is on — the census is one extra AOT compile, which
+    a default run must not pay. Returns ``(fleet, meter)``; both are
+    inert no-ops while ``health.ENABLED`` is off."""
+    fleet = health.FleetHealth()
+    fleet.register("replay", server.health_scrape)
+    if infer_server is not None:
+        fleet.register("inference", infer_server.health_scrape)
+    flops = peak = None
+    if health.ENABLED:
+        from distributed_deep_q_tpu.profiling import (
+            fused_train_flops, peak_flops_for)
+        peak = peak_flops_for()
+        if fused and solver is not None and replay is not None:
+            flops = fused_train_flops(solver, replay,
+                                      cfg.replay.fused_chain)
+    from distributed_deep_q_tpu.profiling import MFUMeter
+    return fleet, MFUMeter(flops, peak)
+
+
+def _health_tick(fleet, meter, server, gstep: int,
+                 scrape: bool = True) -> dict:
+    """Per-log-tick health/efficiency record: live MFU + ingest
+    utilization gauges, fleet self-accounting, and the aggregated
+    verdict (a JSON-able dict — ``Metrics.log`` passes non-numerics
+    through to the run JSONL untouched). Empty while disabled."""
+    if not health.ENABLED:
+        return {}
+    fc = server.flow_counters()
+    out = meter.update(gstep, ingest_rate=fc["ingest_rate"],
+                       consume_rate=fc["consume_rate"])
+    v = fleet.scrape() if scrape else fleet.last()
+    out.update(fleet.gauges())
+    out["health/verdict"] = v.to_jsonable()
+    return out
+
+
 def _tear_down_rpc_plane(cfg: Config, server, sup, infer_server=None) -> None:
     sup.stop()
     if infer_server is not None:
@@ -1121,6 +1167,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
 
     metrics = metrics or Metrics()
     tracing.configure_from(cfg.trace)  # learner-process tracer state
+    health.configure_from(cfg.health)  # learner-process health plane
     probe = _probe_envs(cfg)
     cfg.net.num_actions = probe.num_actions
     obs_shape = probe.obs_shape
@@ -1186,6 +1233,9 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     _publish_weights(server, infer_server, solver.get_weights())
 
     fused_per = isinstance(replay, DevicePERFrameReplay)
+    fleet_health, mfu_meter = _bring_up_health_plane(
+        cfg, server, infer_server, solver=solver, replay=replay,
+        fused=fused_per)
     writeback = None
     if replay.prioritized and not fused_per:
         from distributed_deep_q_tpu.replay.prioritized import make_writeback
@@ -1326,9 +1376,16 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                 # gauges, and the fleet counters actors flushed back
                 infer_tm = (infer_server.telemetry_summary()
                             if infer_server is not None else {})
+                # health plane: live MFU/ingest-utilization gauges + the
+                # aggregated fleet verdict (scraped every
+                # health.scrape_every log ticks; {} while disabled)
+                hk = _health_tick(
+                    fleet_health, mfu_meter, server, gstep,
+                    scrape=(gstep // log_every)
+                    % max(cfg.health.scrape_every, 1) == 0)
                 metrics.log(gstep, **summary, **timer.summary(),
                             **server.telemetry_summary(), **infer_tm,
-                            **metrics.telemetry())
+                            **metrics.telemetry(), **hk)
     finally:
         trace.close()
         if stager is not None:
@@ -1388,6 +1445,7 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
 
     metrics = metrics or Metrics()
     tracing.configure_from(cfg.trace)  # learner-process tracer state
+    health.configure_from(cfg.health)  # learner-process health plane
     probe = _probe_envs(cfg)
     cfg.net.num_actions = probe.num_actions
     pixel = probe.obs_dtype == np.uint8
@@ -1451,6 +1509,10 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
     # Prioritized-only (the device sampler draws from the priority row)
     fused_seq = (device_seq and cfg.replay.device_per
                  and cfg.replay.prioritized)
+    # no fused-flops census on the sequence program (its scan carries
+    # recurrent state — the transition-path census doesn't apply), so
+    # live MFU is absent here; steps/s + ingest utilization still emit
+    fleet_health, mfu_meter = _bring_up_health_plane(cfg, server)
     writeback = None
     if replay.prioritized and not fused_seq:
         from distributed_deep_q_tpu.replay.prioritized import make_writeback
@@ -1533,9 +1595,13 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
                     "actor_restarts": sup.restarts,
                     "actor_kill_escalations": sup.kill_escalations,
                 }
+                hk = _health_tick(
+                    fleet_health, mfu_meter, server, gstep,
+                    scrape=(gstep // log_every)
+                    % max(cfg.health.scrape_every, 1) == 0)
                 metrics.log(gstep, **summary, **timer.summary(),
                             **server.telemetry_summary(),
-                            **metrics.telemetry())
+                            **metrics.telemetry(), **hk)
     finally:
         _tear_down_rpc_plane(cfg, server, sup)
         if tracing.ENABLED:

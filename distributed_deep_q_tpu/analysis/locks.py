@@ -170,6 +170,22 @@ DEFAULT_REGISTRY = LockRegistry(
         "latency_ms":       Guard("_lock", "InferenceTelemetry"),
         "batch_rows":       Guard("_lock", "InferenceTelemetry"),
         "forward_ms":       Guard("_lock", "InferenceTelemetry"),
+        # HealthMonitor (ISSUE 13): rings, rule hysteresis state, prev
+        # histogram snapshots, and the cached verdict are written on the
+        # telemetry cadence and read from serve threads answering the
+        # ``health`` RPC — one RLock guards them all (helpers re-acquire
+        # lexically)
+        "_series":          Guard("_lock", "HealthMonitor"),
+        "_rule_state":      Guard("_lock", "HealthMonitor"),
+        "_prev_snaps":      Guard("_lock", "HealthMonitor"),
+        "_watch_cache":     Guard("_lock", "HealthMonitor"),
+        "_n_samples":       Guard("_lock", "HealthMonitor"),
+        "_last_verdict":    Guard("_lock", "HealthMonitor"),
+        # FleetHealth: member table + aggregate verdict cross the
+        # supervisor loop and whoever reads last()/gauges()
+        "_members":         Guard("_lock", "FleetHealth"),
+        "_scrape_errors":   Guard("_lock", "FleetHealth"),
+        "_fleet_verdict":   Guard("_lock", "FleetHealth"),
         # NOTE deliberately unregistered: ReplayFeedServer.last_seen is a
         # GIL-atomic monotonic stamp dict (single-writer per key, reader
         # tolerates staleness); DeviceStager._err is benign once-set.
@@ -182,6 +198,7 @@ DEFAULT_REGISTRY = LockRegistry(
         "distributed_deep_q_tpu/rpc/replay_server.py",
         "distributed_deep_q_tpu/rpc/inference_server.py",
         "distributed_deep_q_tpu/actors/supervisor.py",
+        "distributed_deep_q_tpu/health.py",
         "distributed_deep_q_tpu/replay/staging.py",
         "distributed_deep_q_tpu/replay/columnar.py",
         "distributed_deep_q_tpu/native/__init__.py",

@@ -41,7 +41,8 @@ RULE_METRIC = "metric_keys.unknown-metric"
 RULE_SPAN = "metric_keys.unknown-span"
 
 NAMESPACES = ("rpc", "fleet", "queue", "durability", "flow", "trace",
-              "learner", "ingest", "inference", "shard", "actor")
+              "learner", "ingest", "inference", "shard", "actor",
+              "health", "train")
 _NS_RE = re.compile(r"^(?:%s)/.+" % "|".join(NAMESPACES))
 
 EMITTERS = frozenset(
@@ -123,6 +124,28 @@ REGISTRY = frozenset({
     "actor/infer_rtt_ms",
     "actor/vector_rows",
     "actor/auto_resets",
+    # health & SLO plane (ISSUE 13): windowed p99 series the SLO rules
+    # watch (``*_p99`` names are ring-sampled histogram-window deltas,
+    # not cumulative summary suffixes), the starvation fraction gauge,
+    # monitor/aggregator self-telemetry, and the fleet verdict key
+    "flow/credit_starvation",
+    "rpc/add_transitions_ms",
+    "rpc/add_transitions_ms_p99",
+    "rpc/*_ms_p99",
+    "inference/latency_ms_p99",
+    "health/samples",
+    "health/series",
+    "health/findings",
+    "health/degraded",
+    "health/critical",
+    "health/members",
+    "health/scrape_errors",
+    "health/verdict",
+    # live efficiency accounting (ISSUE 13): learner-loop gauges fed by
+    # profiling.MFUMeter from per-window step rates + the flops census
+    "train/steps_per_s",
+    "train/mfu",
+    "train/ingest_utilization",
 })
 
 _TRACING_REL = os.path.join("distributed_deep_q_tpu", "tracing.py")

@@ -339,6 +339,31 @@ class TraceConfig:
 
 
 @dataclass
+class HealthConfig:
+    """Health plane (``distributed_deep_q_tpu/health.py``).
+
+    Off by default; when off every monitor entry point is a single
+    module-flag branch returning preallocated constants. When on, each
+    server samples its own telemetry into fixed-capacity rings and the
+    supervisor aggregates every member's ``health`` RPC verdict into
+    one fleet ``HealthVerdict`` logged as ``health/verdict``.
+    """
+
+    enabled: bool = False
+    # fixed capacity of each per-key time-series ring (drop-oldest)
+    ring_capacity: int = 512
+    # multi-window burn-rate alerting: a rule fires only when BOTH
+    # windows have burned their budget; it clears (hysteresis) when the
+    # fast window cools below clear_ratio. Per-rule overrides win.
+    fast_window_s: float = 30.0
+    slow_window_s: float = 300.0
+    clear_ratio: float = 0.5
+    # supervisor fleet-scrape cadence (log ticks between scrapes; the
+    # scrape itself is one in-process call + one RPC per remote member)
+    scrape_every: int = 1
+
+
+@dataclass
 class InferenceConfig:
     """Batched inference plane (``rpc/inference_server.py``).
 
@@ -385,6 +410,7 @@ class Config:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
     inference: InferenceConfig = field(default_factory=InferenceConfig)
+    health: HealthConfig = field(default_factory=HealthConfig)
 
     def replace(self, **kv: Any) -> "Config":
         return dataclasses.replace(self, **kv)

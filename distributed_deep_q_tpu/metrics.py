@@ -121,6 +121,71 @@ class Histogram:
         self.vmin = math.inf
         self.vmax = -math.inf
 
+    # -- merge / snapshot / delta (health plane, ISSUE 13) ------------------
+    def _same_geometry(self, other: "Histogram") -> bool:
+        return (self._lo == other._lo and self._scale == other._scale
+                and len(self._counts) == len(other._counts))
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold ``other`` into self (cross-shard / cross-process
+        aggregation). Bucket geometry must match exactly: counts add
+        elementwise (underflow/overflow included), ``vmin``/``vmax``
+        take min/max — so a merge of disjoint streams is bitwise equal
+        to one histogram that observed every value, and percentiles of
+        the merge are IDENTICAL to single-stream percentiles (pinned by
+        tests). Returns self for chaining."""
+        if not self._same_geometry(other):
+            raise ValueError(
+                f"histogram geometry mismatch: lo={self._lo}/{other._lo} "
+                f"scale={self._scale}/{other._scale} "
+                f"buckets={len(self._counts)}/{len(other._counts)}")
+        for i, c in enumerate(other._counts):
+            self._counts[i] += c
+        self.count += other.count
+        self.total += other.total
+        self.vmin = min(self.vmin, other.vmin)
+        self.vmax = max(self.vmax, other.vmax)
+        return self
+
+    def snapshot(self) -> "Histogram":
+        """Cheap point-in-time copy (no __init__ re-derivation) — the
+        cumulative state a later ``delta()`` subtracts to produce a
+        sliding-window view."""
+        s = Histogram.__new__(Histogram)
+        s._lo = self._lo
+        s._log_lo = self._log_lo
+        s._scale = self._scale
+        s._counts = list(self._counts)
+        s.count = self.count
+        s.total = self.total
+        s.vmin = self.vmin
+        s.vmax = self.vmax
+        return s
+
+    def delta(self, prev: "Histogram") -> "Histogram":
+        """Windowed view: observations in self but not in ``prev`` (an
+        earlier snapshot of the SAME cumulative histogram). Counts and
+        totals subtract per bucket; ``vmin``/``vmax`` keep the cumulative
+        extremes — window extrema are unrecoverable from bucket counts,
+        so percentile clamping stays conservative (documented semantics,
+        pinned by tests). If the source was reset since ``prev`` (count
+        went backwards) the full current state is returned instead of a
+        nonsense negative window."""
+        if not self._same_geometry(prev):
+            raise ValueError("histogram geometry mismatch in delta()")
+        if self.count < prev.count:
+            return self.snapshot()
+        d = Histogram.__new__(Histogram)
+        d._lo = self._lo
+        d._log_lo = self._log_lo
+        d._scale = self._scale
+        d._counts = [a - b for a, b in zip(self._counts, prev._counts)]
+        d.count = self.count - prev.count
+        d.total = self.total - prev.total
+        d.vmin = self.vmin
+        d.vmax = self.vmax
+        return d
+
 
 class Metrics:
     def __init__(self, jsonl_path: str | None = None,

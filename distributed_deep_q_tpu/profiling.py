@@ -30,6 +30,7 @@ from collections import defaultdict
 from typing import Iterator
 
 import jax
+import numpy as np
 
 from distributed_deep_q_tpu.metrics import Histogram
 
@@ -246,6 +247,127 @@ class StepTimer:
             # exactly (steps−1) intra-window intervals over (steps−1),
             # keeping windows mutually consistent
             self._last_step_t = None
+        return out
+
+
+# -- flops-per-step census (promoted from bench.py for live MFU) -----------
+
+# bf16 peak FLOP/s by device_kind prefix (public spec sheets)
+PEAK_FLOPS = {
+    "TPU v6 lite": 918e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5": 459e12,      # v5p
+    "TPU v4": 275e12,
+    "TPU v3": 123e12,      # per chip (2 cores)
+}
+
+
+def peak_flops_for(device=None) -> float | None:
+    """Spec-sheet bf16 peak for ``device`` (default: the first local
+    device). None when the device publishes no peak we know (CPU
+    containers) — MFU is then absent rather than invented."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "")
+    for prefix, peak in sorted(PEAK_FLOPS.items(),
+                               key=lambda kv: -len(kv[0])):
+        if kind.startswith(prefix):
+            return peak
+    return None
+
+
+def xla_flops(solver, replay, batch) -> float | None:
+    """FLOPs of the compiled ring train step, from XLA's cost model."""
+    try:
+        fn = solver.learner._ring_steps[tuple(solver.config.net.frame_shape)]
+        clean = {k: v for k, v in batch.items()
+                 if k not in ("index", "_sampled_at")}
+        cost = fn.lower(solver.state, replay.ring, clean).compile() \
+                 .cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def fused_train_flops(solver, replay, chain: int) -> float | None:
+    """Per-grad-step FLOPs of the FUSED train program — the same program
+    the MFU denominator times (ADVICE r4: the r4 numerator came from the
+    uniform ring step, a cross-program mismatch). XLA's cost model counts
+    a ``lax.scan`` body ONCE (verified against the analytic count: the
+    batch-512 chained program reports ~44.8 GF regardless of chain), so
+    the figure is already per-step."""
+    try:
+        sample, train = solver.learner._device_per_steps[
+            (solver._dp_spec, chain)]
+        cursors, sizes = replay.device_inputs()
+        betas = np.full(chain, 0.5, np.float32)
+        keys = np.zeros((replay.num_shards, chain, 2), np.uint32)
+        rows = replay.dstate
+        # eval_shape: the lowering only needs avals — no device sample
+        # execution, no sampling-key-stream side effect
+        metas, win, idx = jax.eval_shape(
+            sample, keys, rows.frames, rows.action, rows.reward,
+            rows.done, rows.boundary, rows.prio, np.asarray(cursors),
+            np.asarray(sizes), betas)
+        cost = train.lower(solver.state, metas, win, idx, rows.prio,
+                           rows.maxp).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+class MFUMeter:
+    """Live model-FLOPs-utilization gauge (health plane, ISSUE 13).
+
+    ``bench.py`` already derives MFU offline — flops-per-step (from the
+    compiled program's cost analysis) × measured steps/s ÷ the device's
+    peak — but a derivation over one bench window is not an ops signal.
+    This meter closes the loop at runtime: the learner calls
+    ``update(gstep)`` on its logging cadence, the meter converts the
+    grad-step delta over the wall-clock window into steps/s and emits
+    ``train/steps_per_s`` + ``train/mfu`` (and, fed the flow plane's
+    rates, ``train/ingest_utilization`` — the fraction of ingested rows
+    the learner actually consumes). ``peak_flops`` is None on devices
+    with no published peak (CPU containers): MFU is then simply absent
+    from the gauges rather than a made-up number — same honesty rule as
+    the bench.
+    """
+
+    def __init__(self, flops_per_step: float | None,
+                 peak_flops: float | None):
+        self.flops_per_step = (float(flops_per_step)
+                               if flops_per_step else None)
+        self.peak_flops = float(peak_flops) if peak_flops else None
+        self._last_t: float | None = None
+        self._last_step = 0
+
+    def update(self, gstep: int, t: float | None = None,
+               ingest_rate: float | None = None,
+               consume_rate: float | None = None) -> dict[str, float]:
+        """One window: gauges for the steps/s since the previous call
+        (empty on the first call — no window yet)."""
+        if t is None:
+            t = time.monotonic()
+        if self._last_t is None:
+            self._last_t, self._last_step = t, int(gstep)
+            return {}
+        dt = max(t - self._last_t, 1e-9)
+        rate = max(int(gstep) - self._last_step, 0) / dt
+        self._last_t, self._last_step = t, int(gstep)
+        out = {"train/steps_per_s": round(rate, 3)}
+        if self.flops_per_step and self.peak_flops:
+            out["train/mfu"] = round(
+                self.flops_per_step * rate / self.peak_flops, 4)
+        if ingest_rate is not None and consume_rate is not None:
+            util = (min(consume_rate / ingest_rate, 1.0)
+                    if ingest_rate > 1e-9 else 0.0)
+            out["train/ingest_utilization"] = round(util, 4)
         return out
 
 

@@ -342,7 +342,23 @@ class FlowController:
                 "ingest_rate": self._ingested.rate(),
                 "staged_rows": self._staged(),
                 "credits": dict(self.credits),
+                "credit_starvation": self._credit_starvation(),
             }
+
+    def _credit_starvation(self) -> float:
+        """Fraction of the fleet pinned at (or below) the credit floor —
+        the health plane's leading indicator that admission is throttling
+        actors before any shed happens. Healthy grants sit strictly
+        above ``flush_credit_floor`` only when headroom allows; degraded
+        mode grants 0 to everyone, so the gauge saturates at 1.0.
+        Re-entrant (callers hold ``replay_lock``) but lexical, so the
+        lock discipline pass sees it."""
+        with self.replay_lock:
+            if not self.credits:
+                return 0.0
+            floor = self.cfg.flush_credit_floor
+            starved = sum(1 for c in self.credits.values() if c <= floor)
+            return starved / len(self.credits)
 
     # callers hold replay_lock (RLock) — these only read the replay object
 

@@ -45,7 +45,7 @@ from typing import Any
 
 import numpy as np
 
-from distributed_deep_q_tpu import tracing
+from distributed_deep_q_tpu import health, tracing
 from distributed_deep_q_tpu.metrics import Histogram
 from distributed_deep_q_tpu.rpc import faultinject
 from distributed_deep_q_tpu.rpc.flowcontrol import FlowConfig, FlowController
@@ -136,6 +136,14 @@ class InferenceTelemetry:
             out.update(self.forward_ms.summary("inference/forward_ms"))
             return out
 
+    def latency_snapshots(self) -> dict[str, Histogram]:
+        """Cumulative-histogram snapshots for the health plane's
+        sliding-window p99 diffs (same contract as the replay feed's
+        ``ServerTelemetry.latency_snapshots``)."""
+        with self._lock:
+            return {"inference/latency_ms": self.latency_ms.snapshot(),
+                    "inference/forward_ms": self.forward_ms.snapshot()}
+
 
 class InferenceServer:
     """Microbatching action server over the v4 wire protocol.
@@ -153,6 +161,11 @@ class InferenceServer:
         self.max_batch = max(int(max_batch), 1)
         self._cutoff_s = max(int(cutoff_us), 0) / 1e6
         self.telemetry = InferenceTelemetry()
+        # health plane (ISSUE 13): local monitor answering the `health`
+        # verb; free while cfg.health is off (module flag)
+        self.health_monitor = health.HealthMonitor(
+            rules=health.default_inference_rules(),
+            trends=health.default_inference_trends(), name="inference")
         self.last_seen: dict[int, float] = {}
         # request queue: pending list + row gauge + shutdown flag, all
         # under one condition the batcher sleeps on
@@ -207,6 +220,16 @@ class InferenceServer:
         out["inference/compiled_buckets"] = float(
             len(self.policy.compiled_buckets()))
         return out
+
+    def health_scrape(self) -> dict[str, Any]:
+        """Body of the ``health`` verb: sample telemetry + latency
+        snapshots into this plane's monitor and return the verdict as a
+        flat wire dict."""
+        if not health.ENABLED:
+            return health.verdict_to_wire(health.NULL_VERDICT)
+        return self.health_monitor.scrape(
+            gauges=self.telemetry_summary(),
+            hists=self.telemetry.latency_snapshots())
 
     def close(self) -> None:
         self._stop.set()
@@ -295,6 +318,9 @@ class InferenceServer:
 
         if method == "heartbeat":
             return {"ok": True}
+
+        if method == "health":
+            return self.health_scrape()
 
         if method == "stats":
             out: dict[str, Any] = {

@@ -32,7 +32,7 @@ from typing import Any
 
 import numpy as np
 
-from distributed_deep_q_tpu import tracing
+from distributed_deep_q_tpu import health, tracing
 from distributed_deep_q_tpu.metrics import Histogram
 from distributed_deep_q_tpu.rpc import faultinject
 from distributed_deep_q_tpu.rpc.flowcontrol import FlowConfig, FlowController
@@ -229,6 +229,16 @@ class ServerTelemetry:
                     prefix="trace/ingest_lag_ms"))
             return out
 
+    def latency_snapshots(self) -> dict[str, Histogram]:
+        """Point-in-time copies of the cumulative per-method latency
+        histograms, keyed by their metric prefix — the health plane
+        diffs consecutive snapshots into sliding-window p99 series
+        (``Histogram.delta``), which cumulative percentiles can't give
+        (a cumulative p99 never recovers from one bad minute)."""
+        with self._lock:
+            return {f"rpc/{m}_ms": h.snapshot()
+                    for m, h in self.method_lat.items()}
+
     def per_actor_env_steps(self) -> tuple[np.ndarray, np.ndarray]:
         with self._lock:
             ids = sorted(self.actor_env_steps)
@@ -289,6 +299,13 @@ class ReplayFeedServer:
         # EWMA half-life after a warm boot, so it rides in no snapshot
         self.flow = FlowController(flow or FlowConfig(), self.replay_lock,
                                    replay)
+        # health plane (ISSUE 13): this server's local monitor — sampled
+        # on every `health` scrape, so a run that never scrapes pays
+        # nothing beyond construction (and the module flag keeps even
+        # scrapes free when cfg.health is off)
+        self.health_monitor = health.HealthMonitor(
+            rules=health.default_server_rules(),
+            trends=health.default_server_trends(), name="replay")
         self._params_wire: bytes | None = None  # pre-encoded θ frame
         self._params_version = 0
         self._params_lock = threading.Lock()
@@ -756,6 +773,11 @@ class ReplayFeedServer:
         if method == "heartbeat":
             return {"ok": True}
 
+        if method == "health":
+            # one scrape = sample current telemetry into the windowed
+            # rings + evaluate SLO/trend rules → flat wire verdict
+            return self.health_scrape()
+
         if method == "stats":
             with self.replay_lock:
                 out = {
@@ -966,12 +988,28 @@ class ReplayFeedServer:
         out["flow/shed_total"] = fc["shed_total"]
         out["flow/consume_rate"] = round(fc["consume_rate"], 3)
         out["flow/ingest_rate"] = round(fc["ingest_rate"], 3)
+        # leading overload indicator (health plane): fraction of the
+        # fleet pinned at/below the credit floor before any shed
+        out["flow/credit_starvation"] = round(fc["credit_starvation"], 4)
         # shard-local ingest rate: with per-host data planes this equals
         # the flow-plane rate because nothing else feeds the shard
         out["shard/ingest_rate"] = round(fc["ingest_rate"], 3)
         if tracing.ENABLED:  # span-buffer/drop + clock-skew gauges
             out.update(tracing.counters())
         return out
+
+    def health_scrape(self) -> dict[str, Any]:
+        """Body of the ``health`` RPC verb (also callable in-process by
+        the supervisor's ``FleetHealth``): sample the current telemetry
+        summary + per-method latency snapshots into this server's
+        monitor, evaluate the SLO/trend rules, and return the verdict
+        as a flat wire dict (findings JSON-encoded — the protocol
+        carries no nested structures)."""
+        if not health.ENABLED:
+            return health.verdict_to_wire(health.NULL_VERDICT)
+        return self.health_monitor.scrape(
+            gauges=self.telemetry_summary(),
+            hists=self.telemetry.latency_snapshots())
 
 
 def _takes_stream(replay) -> bool:
@@ -1046,6 +1084,11 @@ class ReplayFeedClient:
 
     def add_transitions(self, **batch: Any) -> dict[str, Any]:
         return self.call("add_transitions", **batch)
+
+    def health(self) -> dict[str, Any]:
+        """Scrape the server's health verdict (flat wire dict; decode
+        with ``health.verdict_from_wire``)."""
+        return self.call("health")
 
     def get_params(self, have_version: int = -1):
         """Returns (version, weights-or-None if unchanged/unpublished)."""

@@ -41,6 +41,11 @@ SPREAD_KEY = {
     # records that sum next to each ratio
     "multihost_linearity_2x": "multihost_linearity_2x_spread",
     "multihost_linearity_4x": "multihost_linearity_4x_spread",
+    # health-plane overhead rows (ISSUE 13) share one measured spread
+    "health_sample_us": "health_spread",
+    "health_verdict_us": "health_spread",
+    "health_disabled_us": "health_spread",
+    "mfu_live": "flagship_spread",
 }
 
 # substrings marking metrics where UP is the bad direction
@@ -48,7 +53,7 @@ SPREAD_KEY = {
 # replay traffic is a sharding violation, so up must gate, and the
 # common old=0 case makes any appearance an infinite regression)
 _LOWER_BETTER = ("_ms", "_fusions", "_convs", "_copies", "fusions",
-                 "spread", "_rpcs")
+                 "spread", "_rpcs", "_us")
 # keys that are configuration echoes / identities, not metrics
 # (max_in_flight_rows is the writers' backpressure watermark — a state
 # echo of the pacing loop, not a quality axis with a bad direction;
@@ -61,7 +66,10 @@ _SKIP = ("_chain_k", "_vs_", "vs_baseline", "ring_capacity",
          "flagship_batch", "concurrent_writers", "peak_flops", "n", "rc",
          "flops_per_step", "max_in_flight_rows", "inference_slo_ms",
          "inference_max_batch", "inference_cutoff_us", "sheds",
-         "local_actions_per_s", "n_hosts", "dispatch_k", "n_envs")
+         "local_actions_per_s", "n_hosts", "dispatch_k", "n_envs",
+         # config echo: the live-vs-offline MFU agreement bound bench.py
+         # asserts; the gated quality axes are mfu / mfu_live themselves
+         "mfu_live_tolerance")
 
 
 def _parsed(path: str) -> dict:

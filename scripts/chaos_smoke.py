@@ -1,6 +1,6 @@
 """Chaos smoke — prove the RPC fault-tolerance stack end to end.
 
-Seven modes:
+Eight modes:
 
 ``python scripts/chaos_smoke.py [num_actors] [spec]`` (default)
     Threaded actor fleet over the production wire protocol: resilient
@@ -54,6 +54,21 @@ Seven modes:
     tick's action vector matches a local same-seed oracle replay of the
     identical ε-stream, so the greedy-subset batching (only non-explore
     rows ride the RPC) never crossed rows under fault load.
+
+``python scripts/chaos_smoke.py health [spec]``
+    Health-plane acceptance (ISSUE 13): clean traffic streams into a
+    ``ReplayFeedServer`` whose ``health`` RPC a supervisor-side
+    ``FleetHealth`` scrapes on every tick, with the SLO windows shrunk
+    to fractions of a second. Mid-run, ``corrupt=`` wire chaos is
+    installed: CRC-rejected frames move ``rpc/checksum_errors``, whose
+    rate_above(0) burn-rate rule must flip the FLEET verdict ok →
+    degraded with the finding naming ``wire_integrity``; after the
+    chaos is uninstalled the hysteresis clear must bring it back to ok.
+    The gate: the full ok → degraded → ok arc, ZERO critical flaps
+    (every default rule is degraded-severity — a wire fault must never
+    page as critical), and the per-tick ``health/verdict`` JSONL the
+    run writes passes ``telemetry_report``'s strict SLO checks after
+    recovery.
 
 ``python scripts/chaos_smoke.py durability [cycles] [spec]``
     Crash-recovery acceptance (ISSUE 6): the server is hard-killed at
@@ -789,6 +804,170 @@ def run_vector_chaos_smoke(
     return verdict
 
 
+def run_health_smoke(spec: str = "corrupt=0.35,seed=41",
+                     deadline: float = 45.0) -> dict:
+    """Injected wire fault drives the fleet verdict ok → degraded → ok.
+
+    Every flush and every fleet scrape opens a FRESH connection — the
+    chaos shim wraps sockets at connect time, so installing/uninstalling
+    the plan at phase boundaries takes effect within one tick. The SLO
+    windows are shrunk to fractions of a second (production keeps
+    minutes); the burn-rate math is identical."""
+    from distributed_deep_q_tpu import health
+    from distributed_deep_q_tpu.metrics import Metrics
+    from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
+    from distributed_deep_q_tpu.rpc import faultinject
+    from distributed_deep_q_tpu.rpc.replay_server import (
+        ReplayFeedClient, ReplayFeedServer)
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts"))
+    from telemetry_report import load_records, slo_problems
+
+    health.configure(enabled=True, fast_window_s=0.5, slow_window_s=1.5,
+                     clear_ratio=0.5)
+    jsonl = tempfile.mktemp(prefix="health_smoke_", suffix=".jsonl")
+    metrics = Metrics(jsonl_path=jsonl)
+    replay = ReplayMemory(1 << 16, (2,), np.float32, seed=0)
+    server = ReplayFeedServer(replay)
+    host, port = server.address
+    fleet = health.FleetHealth()
+
+    def scrape_rpc() -> dict:
+        c = ReplayFeedClient(host, port, actor_id=99, timeout=5.0)
+        try:
+            return c.health()
+        finally:
+            c.close()
+
+    fleet.register("replay", scrape_rpc)
+
+    seq = [0]
+
+    def push_one() -> None:
+        # stimulus traffic; under corrupt chaos a flush may need several
+        # tries (CRC reject → error reply) or never land — both fine,
+        # the traffic only exists to exercise the wire
+        rows = 8
+        ids = seq[0] * 1_000 + np.arange(rows, dtype=np.float32)
+        obs = np.stack([ids, ids], axis=1)
+        for _ in range(20):
+            c = None
+            try:
+                c = ReplayFeedClient(host, port, actor_id=0, timeout=5.0)
+                resp = c.call(
+                    "add_transitions", flush_seq=seq[0], obs=obs,
+                    next_obs=obs, action=np.zeros(rows, np.int32),
+                    reward=np.zeros(rows, np.float32),
+                    discount=np.ones(rows, np.float32))
+            except Exception:  # noqa: BLE001 — chaos; retry fresh
+                time.sleep(0.002)
+                continue
+            finally:
+                if c is not None:
+                    try:
+                        c.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+            if resp.get("error") or resp.get("shed"):
+                time.sleep(0.002)
+                continue
+            seq[0] += 1
+            return
+
+    step = [0]
+    statuses: list[str] = []
+    critical_flaps = [0]
+    rules_fired: set[str] = set()
+
+    def tick(collect_rules: bool = False) -> None:
+        push_one()
+        v = fleet.scrape()
+        statuses.append(v.status)
+        if v.status == "critical":
+            critical_flaps[0] += 1
+        if collect_rules and v.status != "ok":
+            rules_fired.update(f.rule for f in v.findings)
+        metrics.log(step[0], **{**fleet.gauges(),
+                                "health/verdict": v.to_jsonable()})
+        step[0] += 1
+        time.sleep(0.03)
+
+    def run_until(pred, min_s: float = 0.0, max_s: float = 15.0,
+                  collect_rules: bool = False) -> bool:
+        t0 = time.monotonic()
+        while True:
+            tick(collect_rules)
+            elapsed = time.monotonic() - t0
+            if elapsed >= min_s and pred():
+                return True
+            if elapsed > max_s:
+                return False
+
+    t0 = time.perf_counter()
+    max_s = deadline / 3
+    # phase A: clean traffic must settle on ok with warmed rings
+    phase_a_ok = run_until(lambda: statuses[-1] == "ok",
+                           min_s=1.0, max_s=max_s)
+    # phase B: corrupt wire — CRC rejects burn wire_integrity's budget.
+    # A failed scrape already degrades the verdict (member_unreachable),
+    # so the phase gate demands the burn-rate rule ITSELF: degraded with
+    # wire_integrity named in the findings
+    plan = faultinject.install(spec)
+    degraded_reached = run_until(
+        lambda: statuses[-1] == "degraded"
+        and "wire_integrity" in rules_fired,
+        max_s=max_s, collect_rules=True)
+    # phase C: recovery — the fast window cools, hysteresis clears
+    faultinject.uninstall()
+    recovered = run_until(
+        lambda: len(statuses) >= 3 and statuses[-3:] == ["ok"] * 3,
+        min_s=0.5, max_s=max_s)
+    wall = time.perf_counter() - t0
+
+    checksum_errors = \
+        server.telemetry.robustness_counters()["checksum_errors"]
+    metrics.close()
+    server.close()
+    health.reset()
+
+    # the run JSONL must carry schema-valid aggregated verdicts and pass
+    # the report's strict SLO checks now that the run ended ok
+    records = load_records(jsonl)
+    verdicts = [r["health/verdict"] for r in records
+                if isinstance(r.get("health/verdict"), dict)]
+    schema_ok = bool(verdicts) and all(
+        v.get("status") in ("ok", "degraded", "critical")
+        and isinstance(v.get("ok"), bool)
+        and isinstance(v.get("findings"), list)
+        and all(isinstance(f, dict) and "rule" in f and "key" in f
+                and "severity" in f for f in v["findings"])
+        for v in verdicts)
+    slo = slo_problems(records)
+
+    verdict = {
+        "ok": (phase_a_ok and degraded_reached and recovered
+               and critical_flaps[0] == 0
+               and "wire_integrity" in rules_fired
+               and schema_ok and not slo),
+        "phase_a_ok": phase_a_ok,
+        "degraded_reached": degraded_reached,
+        "recovered": recovered,
+        "critical_flaps": critical_flaps[0],
+        "rules_fired": sorted(rules_fired),
+        "wire_checksum_rejections": checksum_errors,
+        "faults_fired": dict(sorted(plan.counters.items())),
+        "scrapes": step[0],
+        "jsonl_records": len(records),
+        "verdicts_logged": len(verdicts),
+        "verdict_schema_ok": schema_ok,
+        "slo_problems": slo,
+        "chaos_spec": spec,
+        "wall_s": round(wall, 2),
+    }
+    return verdict
+
+
 def run_durability_smoke(cycles: int = 20, num_actors: int = 3,
                          flushes_per_cycle: int = 4, rows: int = 8,
                          spec: str = "torn=0.35,corrupt=0.03,seed=23",
@@ -1003,6 +1182,11 @@ if __name__ == "__main__":
     if args and args[0] == "train":
         print(json.dumps(run_train_chaos(args[1:]), default=str))
         sys.exit(0)
+    if args and args[0] in ("health", "--health"):
+        verdict = run_health_smoke(
+            spec=args[1] if len(args) > 1 else "corrupt=0.35,seed=41")
+        print(json.dumps(verdict))
+        sys.exit(0 if verdict["ok"] else 1)
     if args and args[0] in ("durability", "--durability"):
         kwargs = {}
         if len(args) > 1 and args[1].isdigit():

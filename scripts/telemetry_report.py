@@ -18,12 +18,23 @@ Reads the JSONL a ``Metrics(jsonl_path=...)`` run wrote and prints:
   and the ingest-lag histogram; ``learner/time_to_learn_ms`` rides the
   learner table. Runs that never enabled tracing emit none of these
   keys and the sections simply don't print;
+- health & SLO plane — monitor/aggregator self-gauges, live efficiency
+  gauges (``train/steps_per_s``, ``train/mfu``,
+  ``train/ingest_utilization``), and the aggregated fleet verdict the
+  supervisor logged under ``health/verdict`` — final status, how many
+  records spent degraded/critical, and the last verdict's findings;
 - anomalies — bad JSON, non-monotonic steps, logging gaps, stalled
   counters, non-finite values, span-ring overflow.
 
+``--strict`` exits non-zero when anomalies or SLO violations are
+present (same convention as ``scripts/trace_report.py``): any record
+with a CRITICAL fleet verdict, a run that ENDS degraded/critical, or
+any structural anomaly fails the report. Transient degraded windows
+that recover are reported but pass — that is the health plane working.
+
 Pure stdlib (json/math/argparse): usable on any host with the JSONL file,
-no jax/numpy required. ``load_records`` / ``validate_records`` are
-importable by tests and other tooling.
+no jax/numpy required. ``load_records`` / ``validate_records`` /
+``slo_problems`` are importable by tests and other tooling.
 """
 
 from __future__ import annotations
@@ -81,6 +92,37 @@ def validate_records(records: list[dict]) -> list[str]:
 
 def _series(records: list[dict], key: str) -> list:
     return [r[key] for r in records if key in r]
+
+
+def _verdicts(records: list[dict]) -> list[dict]:
+    """The aggregated fleet verdicts a supervisor run logged — the one
+    non-scalar value on the metrics spine (Metrics.log passes dicts
+    through to JSONL; the TB mirror skips them)."""
+    return [v for v in _series(records, "health/verdict")
+            if isinstance(v, dict)]
+
+
+def slo_problems(records: list[dict]) -> list[str]:
+    """SLO violations ``--strict`` gates on: a CRITICAL fleet verdict in
+    ANY record, or a run whose FINAL verdict is not ok. Returns
+    human-readable problem strings naming the violated rules."""
+    verdicts = _verdicts(records)
+    if not verdicts:
+        return []
+    out = []
+    crit = [i for i, v in enumerate(verdicts)
+            if v.get("status") == "critical"]
+    if crit:
+        out.append(f"SLO: fleet verdict CRITICAL in {len(crit)} "
+                   f"record(s) (first at verdict {crit[0]})")
+    final = verdicts[-1]
+    if final.get("status") not in (None, "ok"):
+        rules = sorted({str(f.get("rule", "?"))
+                        for f in final.get("findings") or []
+                        if isinstance(f, dict)})
+        out.append(f"SLO: run ended {final.get('status')}"
+                   + (f" ({', '.join(rules)})" if rules else ""))
+    return out
 
 
 def _hist_groups(records: list[dict], prefix: str) -> dict[str, dict]:
@@ -270,7 +312,37 @@ def render_report(records: list[dict], last: int = 0) -> str:
     _table("data age (ms)", rows,
            ("histogram", "count", "p50", "p95", "p99", "max"), out)
 
-    problems = validate_records(records) + _gap_anomalies(records)
+    # health & SLO plane: self-gauges + live efficiency, then the fleet
+    # verdict trail. Runs without health enabled log none of these keys.
+    rows = []
+    for key in ("health/members", "health/findings", "health/degraded",
+                "health/critical", "health/scrape_errors",
+                "train/steps_per_s", "train/mfu",
+                "train/ingest_utilization"):
+        vals = [v for v in _series(records, key)
+                if isinstance(v, (int, float))]
+        if vals:
+            rows.append((key, vals[-1], min(vals), max(vals)))
+    _table("health & efficiency", rows, ("gauge", "last", "min", "max"),
+           out)
+    verdicts = _verdicts(records)
+    if verdicts:
+        final = verdicts[-1]
+        n_deg = sum(v.get("status") == "degraded" for v in verdicts)
+        n_crit = sum(v.get("status") == "critical" for v in verdicts)
+        out.append("\n== fleet verdict ==")
+        out.append(f"  final status        {final.get('status', '?')}")
+        out.append(f"  degraded records    {n_deg}/{len(verdicts)}")
+        out.append(f"  critical records    {n_crit}/{len(verdicts)}")
+        for f in (final.get("findings") or [])[:10]:
+            if isinstance(f, dict):
+                out.append(
+                    f"  ! [{f.get('severity', '?')}] "
+                    f"{f.get('member') or '-'}: {f.get('rule', '?')} "
+                    f"on {f.get('key', '?')}")
+
+    problems = (validate_records(records) + _gap_anomalies(records)
+                + slo_problems(records))
     drops = [v for v in _series(records, "trace/spans_dropped")
              if isinstance(v, (int, float))]
     if drops and drops[-1] > 0:
@@ -292,6 +364,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("jsonl", help="metrics JSONL file written by a run")
     ap.add_argument("--last", type=int, default=0,
                     help="only the last N records (default: all)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on anomalies or SLO violations")
     args = ap.parse_args(argv)
     try:
         records = load_records(args.jsonl)
@@ -299,6 +373,14 @@ def main(argv: list[str] | None = None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
     print(render_report(records, last=args.last))
+    if args.strict:
+        window = records[-args.last:] if args.last else records
+        problems = (validate_records(window) + _gap_anomalies(window)
+                    + slo_problems(window))
+        if problems:
+            print(f"strict: FAILED ({len(problems)} problem(s), first: "
+                  f"{problems[0]})", file=sys.stderr)
+            return 1
     return 0
 
 

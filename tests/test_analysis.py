@@ -339,10 +339,11 @@ def test_config_schema_parsed_from_real_config():
         config_keys.CONFIG_FILE)
     schema = config_keys.config_schema(cfg_src)
     assert set(schema) == {"net", "replay", "train", "env", "actors",
-                           "mesh", "trace", "inference"}
+                           "mesh", "trace", "inference", "health"}
     assert "num_actions" in schema["net"]
     assert "server_snapshot_path" in schema["train"]
     assert "cutoff_us" in schema["inference"]
+    assert "fast_window_s" in schema["health"]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,318 @@
+"""Health & SLO plane (ISSUE 13): series-ring window math, multi-window
+burn-rate firing and hysteresis clearing at explicit timestamps,
+histogram-delta windowing (cumulative p99 would still alarm, the window
+recovers), fleet aggregation over a LIVE ``health`` RPC round trip,
+verdict wire/JSONL schema, and the disabled-path zero-cost pin
+(mirrors test_tracing's ``_NULL`` discipline)."""
+
+import json
+import math
+import time
+
+import numpy as np
+import pytest
+
+from distributed_deep_q_tpu import health
+from distributed_deep_q_tpu.health import (
+    NULL_VERDICT, FleetHealth, HealthFinding, HealthMonitor, HealthVerdict,
+    SeriesRing, SLORule, TrendRule, verdict_from_wire, verdict_to_wire)
+from distributed_deep_q_tpu.metrics import Histogram
+
+
+@pytest.fixture(autouse=True)
+def _reset_health():
+    health.reset()
+    yield
+    health.reset()
+
+
+# -- series ring + window math ----------------------------------------------
+
+
+def test_series_ring_drops_oldest_and_windows_slice_by_time():
+    r = SeriesRing(4)
+    for i in range(6):
+        r.push(float(i), float(i * 10))
+    assert len(r) == 4
+    assert r.items() == [(2.0, 20.0), (3.0, 30.0), (4.0, 40.0),
+                         (5.0, 50.0)]
+    assert r.last() == (5.0, 50.0)
+    from distributed_deep_q_tpu.health import _window
+    assert _window(r.items(), now=5.0, span=2.0) == \
+        [(3.0, 30.0), (4.0, 40.0), (5.0, 50.0)]
+    assert _window(r.items(), now=100.0, span=2.0) == []
+
+
+def test_rule_validation_rejects_unknown_modes():
+    with pytest.raises(ValueError, match="mode"):
+        SLORule("x", "k", 1.0, mode="sideways")
+    with pytest.raises(ValueError, match="severity"):
+        SLORule("x", "k", 1.0, severity="meh")
+    with pytest.raises(ValueError, match="kind"):
+        TrendRule("x", "k", kind="wiggle")
+
+
+# -- burn-rate engine -------------------------------------------------------
+
+
+def test_burn_rate_fires_and_clears_with_hysteresis():
+    health.configure(enabled=True)
+    rule = SLORule("lat", "inference/latency_ms_p99", target=50.0,
+                   budget=0.25, fast_window_s=10.0, slow_window_s=40.0,
+                   clear_ratio=0.5)
+    mon = HealthMonitor(rules=(rule,))
+    for i in range(40):                       # 40 s healthy at 1 Hz
+        mon.sample({"inference/latency_ms_p99": 10.0}, t=float(i))
+    assert mon.verdict(t=39.0).ok
+    for i in range(40, 80):                   # sustained violation
+        mon.sample({"inference/latency_ms_p99": 80.0}, t=float(i))
+    v = mon.verdict(t=79.0)
+    assert v.status == "degraded" and not v.ok
+    (f,) = v.findings
+    assert f.rule == "lat" and f.kind == "slo"
+    assert f.key == "inference/latency_ms_p99"
+    assert f.burn_fast >= 1.0 and f.burn_slow >= 1.0
+    assert f.value == pytest.approx(80.0) and f.target == 50.0
+    # recovery begins: at t=83 the 10 s fast window still holds 7
+    # violations out of 11 samples → burn 0.636/0.25 ≈ 2.5 ≥ clear_ratio
+    # → hysteresis keeps the rule ACTIVE (no flap on the first good tick)
+    for i in range(80, 84):
+        mon.sample({"inference/latency_ms_p99": 10.0}, t=float(i))
+    assert mon.verdict(t=83.0).status == "degraded"
+    # by t=94 the fast window is all-clean → burn 0 < clear_ratio → clears
+    for i in range(84, 95):
+        mon.sample({"inference/latency_ms_p99": 10.0}, t=float(i))
+    assert mon.verdict(t=94.0).ok
+
+
+def test_single_spike_never_fires_the_slow_window():
+    health.configure(enabled=True)
+    rule = SLORule("lat", "k", target=1.0, budget=0.02,
+                   fast_window_s=5.0, slow_window_s=100.0)
+    mon = HealthMonitor(rules=(rule,))
+    # one spike at the end: the fast window burns hard (1/6 ≫ budget)
+    # but the slow window holds 1/100 = half its budget → no fire
+    for i in range(100):
+        mon.sample({"k": 2.0 if i == 99 else 0.0}, t=float(i))
+    assert mon.verdict(t=99.0).ok
+
+
+def test_rate_above_watches_cumulative_counter():
+    health.configure(enabled=True)
+    rule = SLORule("wire", "rpc/checksum_errors", target=0.0,
+                   mode="rate_above", budget=0.5,
+                   fast_window_s=4.0, slow_window_s=8.0)
+    mon = HealthMonitor(rules=(rule,))
+    for i in range(10):                       # counter parked at 0
+        mon.sample({"rpc/checksum_errors": 0.0}, t=float(i))
+    assert mon.verdict(t=9.0).ok
+    for i in range(10, 20):                   # counter moving every tick
+        mon.sample({"rpc/checksum_errors": float(i - 9)}, t=float(i))
+    v = mon.verdict(t=19.0)
+    assert v.status == "degraded"
+    assert v.findings[0].rule == "wire"
+    for i in range(20, 26):                   # counter frozen again
+        mon.sample({"rpc/checksum_errors": 10.0}, t=float(i))
+    assert mon.verdict(t=25.0).ok
+
+
+# -- trend detectors --------------------------------------------------------
+
+
+def test_trend_monotonic_growth_fires_only_on_real_growth():
+    health.configure(enabled=True)
+    tr = TrendRule("growth", "queue/staged_rows", kind="monotonic_growth",
+                   ratio=2.0, min_points=4)
+
+    def verdict_for(series):
+        mon = HealthMonitor(trends=(tr,))
+        for i, v in enumerate(series):
+            mon.sample({"queue/staged_rows": float(v)}, t=float(i))
+        return mon.verdict(t=float(len(series) - 1))
+
+    v = verdict_for([100, 150, 220, 500])     # monotonic, 5× → fires
+    assert v.status == "degraded"
+    (f,) = v.findings
+    assert f.rule == "growth" and f.kind == "trend"
+    assert f.detail == "monotonic_growth"
+    assert verdict_for([100, 150, 120, 500]).ok   # dipped: not monotonic
+    assert verdict_for([100, 110, 120, 150]).ok   # < ratio× overall
+    assert verdict_for([100, 150, 220]).ok        # < min_points
+    assert verdict_for([0.0, 0.0, 0.0, 0.0]).ok   # flat zero is not growth
+
+
+def test_trend_drift_and_collapse():
+    health.configure(enabled=True)
+    drift = TrendRule("p99_drift", "rpc/*_ms_p99", kind="drift",
+                      ratio=3.0, min_points=4)
+    mon = HealthMonitor(trends=(drift,))
+    for i, v in enumerate([10, 11, 10, 12, 40]):
+        mon.sample({"rpc/flush_ms_p99": float(v)}, t=float(i))
+    v = mon.verdict(t=4.0)
+    assert v.status == "degraded" and v.findings[0].detail == "drift"
+
+    collapse = TrendRule("ingest_dead", "flow/ingest_rate",
+                         kind="collapse", ratio=0.2, floor=1.0)
+    mon = HealthMonitor(trends=(collapse,))
+    for i, v in enumerate([100, 110, 90, 105, 5]):
+        mon.sample({"flow/ingest_rate": float(v)}, t=float(i))
+    v = mon.verdict(t=4.0)
+    assert v.status == "degraded" and v.findings[0].detail == "collapse"
+    # an idle series (median at the floor) never "collapses" from 0 to 0
+    mon = HealthMonitor(trends=(collapse,))
+    for i in range(5):
+        mon.sample({"flow/ingest_rate": 0.0}, t=float(i))
+    assert mon.verdict(t=4.0).ok
+
+
+# -- histogram-delta windowing ----------------------------------------------
+
+
+def test_hist_delta_windows_recover_where_cumulative_would_alarm():
+    """The monitor alerts on the WINDOW p99, so an early latency storm
+    clears once flushes are fast again — even though the cumulative
+    histogram's p99 stays above target forever."""
+    health.configure(enabled=True)
+    rule = SLORule("flush_p99", "rpc/add_transitions_ms_p99",
+                   target=250.0, budget=0.25,
+                   fast_window_s=10.0, slow_window_s=20.0,
+                   clear_ratio=0.5)
+    mon = HealthMonitor(rules=(rule,))
+    h = Histogram()
+    for t in range(0, 21):
+        for _ in range(100):
+            h.observe(500.0 if 1 <= t <= 10 else 1.0)
+        mon.sample(hists={"rpc/add_transitions_ms": h.snapshot()},
+                   t=float(t))
+    # mid-run: the storm fired the rule on windowed p99
+    assert mon.verdict(t=10.0).status == "degraded"
+    # end of run: windows are clean → clears, yet cumulative still bad
+    assert mon.verdict(t=20.0).ok
+    assert h.percentile(0.99) > 250.0
+
+
+def test_sample_stores_only_watched_keys():
+    health.configure(enabled=True)
+    mon = HealthMonitor(rules=(SLORule("r", "flow/ingest_rate", 1.0),))
+    mon.sample({"flow/ingest_rate": 5.0, "unwatched/key": 1.0,
+                "another": 2.0}, t=0.0)
+    assert set(mon._series) == {"flow/ingest_rate"}
+
+
+# -- fleet aggregation over the health RPC ----------------------------------
+
+
+def test_fleet_aggregates_live_health_rpc_round_trip():
+    from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
+    from distributed_deep_q_tpu.rpc.replay_server import (
+        ReplayFeedClient, ReplayFeedServer)
+
+    health.configure(enabled=True, fast_window_s=5.0, slow_window_s=10.0)
+    replay = ReplayMemory(256, (2,), np.float32)
+    server = ReplayFeedServer(replay)
+    host, port = server.address
+    client = ReplayFeedClient(host, port, actor_id=1)
+    try:
+        wire = client.health()
+        assert wire["status"] == "ok" and verdict_from_wire(wire).ok
+        # move the cumulative CRC counter between scrapes: the
+        # wire_integrity rate_above(0) rule must burn and fire
+        for _ in range(12):
+            server.telemetry.record_checksum_error()
+            client.health()
+            time.sleep(0.01)
+        v = verdict_from_wire(client.health())
+        assert v.status == "degraded"
+        assert any(f.rule == "wire_integrity" for f in v.findings)
+
+        fleet = FleetHealth()
+        fleet.register("replay", client.health)
+        idle = HealthMonitor(name="idle")
+        fleet.register("idle", idle.scrape)
+        fv = fleet.scrape()
+        assert fv.status == "degraded"      # worst-of member statuses
+        assert any(f.member == "replay" and f.rule == "wire_integrity"
+                   for f in fv.findings)
+        g = fleet.gauges()
+        assert g["health/members"] == 2.0
+        assert g["health/degraded"] == 1.0 and g["health/critical"] == 0.0
+
+        # an unreachable member degrades the fleet — never criticals it
+        def dead():
+            raise ConnectionError("down")
+
+        fleet.register("gone", dead)
+        fv2 = fleet.scrape()
+        assert fv2.status == "degraded"
+        assert any(f.rule == "member_unreachable" and f.member == "gone"
+                   for f in fv2.findings)
+        assert fleet.gauges()["health/scrape_errors"] >= 1.0
+    finally:
+        client.close()
+        server.close()
+
+
+# -- wire + JSONL schema ----------------------------------------------------
+
+
+def test_verdict_wire_round_trip_and_jsonl_schema():
+    f = HealthFinding(rule="r", key="k", severity="degraded", kind="slo",
+                      value=2.0, target=1.0, burn_fast=3.2, burn_slow=1.1,
+                      member="replay")
+    v = HealthVerdict("degraded", (f,), t=12.5)
+    wire = verdict_to_wire(v)
+    # rpc/protocol.py frames are FLAT: scalars and strings only
+    assert all(isinstance(x, (str, bool, int, float))
+               for x in wire.values())
+    v2 = verdict_from_wire(wire)
+    assert v2.status == "degraded" and len(v2.findings) == 1
+    assert v2.findings[0].rule == "r"
+    assert v2.findings[0].burn_fast == pytest.approx(3.2)
+    assert v2.findings[0].member == "replay"
+
+    j = v.to_jsonable()
+    json.dumps(j)                   # JSONL-safe, no NaN leakage
+    assert j["status"] == "degraded" and j["ok"] is False
+    assert j["t"] == 12.5
+    assert j["findings"][0]["rule"] == "r"
+    assert j["findings"][0]["severity"] == "degraded"
+
+    # NaN value/target cross as None (json.dumps would emit invalid NaN)
+    d = HealthFinding(rule="r2", key="k2").to_dict()
+    assert d["value"] is None and d["target"] is None
+    json.dumps(d)
+    assert math.isnan(HealthFinding.from_dict(d).value)
+
+
+def test_configure_from_health_config():
+    from distributed_deep_q_tpu.config import HealthConfig
+
+    health.configure_from(HealthConfig(
+        enabled=True, ring_capacity=16, fast_window_s=1.0,
+        slow_window_s=2.0, clear_ratio=0.25))
+    assert health.ENABLED
+    assert HealthMonitor()._cap == 16
+
+
+# -- disabled path: zero cost, preallocated singletons ----------------------
+
+
+def test_disabled_path_returns_preallocated_singletons():
+    assert health.ENABLED is False
+    mon = HealthMonitor(rules=health.default_server_rules(),
+                        trends=health.default_server_trends())
+    mon.sample({"rpc/checksum_errors": 5.0},
+               {"rpc/add_transitions_ms": Histogram()}, t=1.0)
+    assert mon._series == {}                      # nothing stored
+    assert mon.verdict(t=1.0) is NULL_VERDICT     # identity, no alloc
+    assert mon.gauges() is health._EMPTY_GAUGES
+    assert mon.scrape({"x": 1.0}) == verdict_to_wire(NULL_VERDICT)
+
+    fleet = FleetHealth()
+
+    def must_not_scrape():
+        raise AssertionError("disabled fleet must never call members")
+
+    fleet.register("m", must_not_scrape)
+    assert fleet.scrape() is NULL_VERDICT
+    assert fleet.gauges() is health._EMPTY_GAUGES

@@ -19,7 +19,7 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / "scripts"))
 
 from telemetry_report import (  # noqa: E402
-    load_records, render_report, validate_records)
+    load_records, render_report, slo_problems, validate_records)
 
 
 # -- histogram math ---------------------------------------------------------
@@ -71,6 +71,95 @@ def test_histogram_empty_and_reset():
     h.reset()
     assert h.summary("x") == {}
     assert h.count == 0
+
+
+def test_histogram_merge_equals_single_stream():
+    """Merging shard-local histograms with identical geometry is bitwise
+    equal to one histogram that observed every value — percentiles of
+    the merge are IDENTICAL to single-stream, not merely close."""
+    rng = np.random.default_rng(3)
+    streams = [rng.lognormal(1.0, 1.5, 400) for _ in range(3)]
+    shards = []
+    for vals in streams:
+        h = Histogram()
+        h.observe_many(vals)
+        shards.append(h)
+    merged = shards[0].snapshot()
+    merged.merge(shards[1]).merge(shards[2])
+    single = Histogram()
+    for vals in streams:
+        single.observe_many(vals)
+    assert merged._counts == single._counts
+    assert merged.count == single.count
+    assert merged.total == pytest.approx(single.total)
+    assert merged.vmin == single.vmin and merged.vmax == single.vmax
+    for q in (0.0, 0.5, 0.95, 0.99, 1.0):
+        assert merged.percentile(q) == single.percentile(q)
+    assert merged.summary("x") == pytest.approx(single.summary("x"))
+
+
+def test_histogram_merge_under_overflow_and_extremes():
+    a = Histogram(lo=1.0, hi=100.0, per_decade=5)
+    b = Histogram(lo=1.0, hi=100.0, per_decade=5)
+    a.observe(1e-6)          # a's underflow
+    a.observe(5.0)
+    b.observe(1e9)           # b's overflow
+    b.observe(0.5)           # b's underflow
+    a.merge(b)
+    assert a.count == 4
+    assert a._counts[0] == 2 and a._counts[-1] == 1   # under/overflow add
+    assert a.vmin == 1e-6 and a.vmax == 1e9           # min/max of both
+    assert a.percentile(1.0) == pytest.approx(1e9)
+
+
+def test_histogram_merge_and_delta_reject_geometry_mismatch():
+    a = Histogram(lo=1e-3, hi=1e5, per_decade=10)
+    for bad in (Histogram(lo=1e-2, hi=1e5, per_decade=10),
+                Histogram(lo=1e-3, hi=1e5, per_decade=5),
+                Histogram(lo=1e-3, hi=1e6, per_decade=10)):
+        with pytest.raises(ValueError, match="geometry"):
+            a.merge(bad)
+        with pytest.raises(ValueError, match="geometry"):
+            a.delta(bad)
+
+
+def test_histogram_snapshot_is_independent():
+    h = Histogram()
+    h.observe(2.0)
+    snap = h.snapshot()
+    h.observe(50.0)
+    assert snap.count == 1 and h.count == 2
+    assert snap.vmax == pytest.approx(2.0) and h.vmax == pytest.approx(50.0)
+
+
+def test_histogram_delta_windows_a_cumulative_stream():
+    h = Histogram()
+    for _ in range(100):
+        h.observe(1.0)
+    prev = h.snapshot()
+    for _ in range(100):
+        h.observe(500.0)
+    win = h.delta(prev)
+    # the window holds ONLY the second batch: p50 sits at ~500, while
+    # the cumulative histogram's p50 still straddles both batches
+    assert win.count == 100
+    assert win.percentile(0.5) == pytest.approx(500.0, rel=0.30)
+    assert win.mean == pytest.approx(500.0)
+    # documented conservatism: vmin/vmax keep the CUMULATIVE extremes
+    # (window extrema are unrecoverable from bucket counts)
+    assert win.vmin == pytest.approx(1.0) and win.vmax == pytest.approx(500.0)
+
+
+def test_histogram_delta_reset_fallback():
+    h = Histogram()
+    for _ in range(10):
+        h.observe(4.0)
+    prev = h.snapshot()
+    h.reset()
+    h.observe(7.0)           # source reset since prev: count went backwards
+    win = h.delta(prev)
+    assert win.count == 1    # full current state, not a negative window
+    assert win.percentile(0.5) == pytest.approx(7.0)
 
 
 def test_metrics_gauges_histograms_flatten(tmp_path):
@@ -231,6 +320,64 @@ def test_report_cli_smoke(tmp_path):
          str(tmp_path / "nope.jsonl")], capture_output=True, text=True,
         timeout=60)
     assert proc.returncode == 1 and "error:" in proc.stderr
+
+
+def _verdict(status, rules=()):
+    return {"status": status, "ok": status == "ok", "t": 1.0,
+            "findings": [{"rule": r, "key": "k", "severity": status,
+                          "kind": "slo"} for r in rules]}
+
+
+def test_slo_problems_gate_semantics():
+    ok = {"step": 2, "t": 2.0, "health/verdict": _verdict("ok")}
+    deg = {"step": 1, "t": 1.0,
+           "health/verdict": _verdict("degraded", ["wire_integrity"])}
+    # transient degraded window that RECOVERS passes — that is the
+    # health plane working, not an SLO violation
+    assert slo_problems([deg, ok]) == []
+    # a run that ENDS degraded fails, naming the violated rule
+    (p,) = slo_problems([ok, deg])
+    assert "degraded" in p and "wire_integrity" in p
+    # any CRITICAL verdict fails even if the run recovers
+    crit = {"step": 1, "t": 1.0,
+            "health/verdict": _verdict("critical", ["oom"])}
+    assert any("CRITICAL" in p for p in slo_problems([crit, ok]))
+    # no health plane in the run → nothing to gate
+    assert slo_problems([{"step": 1, "t": 1.0}]) == []
+
+
+def test_report_renders_health_section_and_strict_gates(tmp_path):
+    recs = [
+        {"step": 1, "t": 1.0, "health/members": 2, "health/findings": 0,
+         "train/steps_per_s": 120.0, "train/mfu": 0.31,
+         "health/verdict": _verdict("ok")},
+        {"step": 2, "t": 2.0, "health/members": 2, "health/findings": 1,
+         "health/verdict": _verdict("degraded", ["wire_integrity"])},
+    ]
+    report = render_report(recs)
+    for needle in ("health & efficiency", "train/mfu", "fleet verdict",
+                   "final status        degraded", "wire_integrity"):
+        assert needle in report, f"missing {needle!r}\n{report}"
+
+    jsonl = tmp_path / "run.jsonl"
+    jsonl.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    cli = [sys.executable, str(REPO / "scripts" / "telemetry_report.py"),
+           str(jsonl)]
+    # non-strict: the degraded tail is reported but does not gate
+    proc = subprocess.run(cli, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    # strict: run ends degraded → convention line on stderr, exit 1
+    proc = subprocess.run(cli + ["--strict"], capture_output=True,
+                          text=True, timeout=60)
+    assert proc.returncode == 1
+    assert "strict: FAILED" in proc.stderr
+    assert "wire_integrity" in proc.stderr
+    # strict over a healthy run passes
+    jsonl.write_text(json.dumps(
+        {"step": 1, "t": 1.0, "health/verdict": _verdict("ok")}) + "\n")
+    proc = subprocess.run(cli + ["--strict"], capture_output=True,
+                          text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
 
 
 # -- tier-1 JSONL contract over a real run (satellite g) --------------------
