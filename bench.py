@@ -240,7 +240,8 @@ def r2d2_train_census(solver, batch) -> dict | None:
 
 def build(cfg_mod, *, capacity: int, batch: int, prioritized: bool,
           pallas: bool, num_streams: int = 1, prefill: int = 40_000,
-          seed: int = 0, device_per: bool = False):
+          seed: int = 0, device_per: bool = False,
+          learn_metrics: bool = False):
     """Construct (solver, replay) for one variant and prefill the ring."""
     import jax
 
@@ -253,7 +254,8 @@ def build(cfg_mod, *, capacity: int, batch: int, prioritized: bool,
                                 dueling=True, compute_dtype="bfloat16")
     cfg.train = cfg_mod.TrainConfig(double_dqn=True,
                                     target_update_period=2500,
-                                    use_pallas_loss=pallas)
+                                    use_pallas_loss=pallas,
+                                    learn_metrics=learn_metrics)
     cfg.replay = cfg_mod.ReplayConfig(
         capacity=capacity, batch_size=batch, n_step=3, write_chunk=1024,
         prioritized=prioritized, device_per=device_per)
@@ -1112,6 +1114,44 @@ def _multihost_curve(note) -> dict:
     return curve
 
 
+def _learn_overhead(cfg_mod, note, *, on_cpu: bool, chain: int,
+                    chunks: int, warmup: int, prefill: int) -> dict:
+    """Measured cost of the learning-dynamics plane (ISSUE 16, PERF.md
+    §16): the b32 fused chained variant timed with ``learn_metrics``
+    off vs on — same ring, same chain, the ONLY delta is the plane
+    accumulation inside the scan body + one finalize per dispatch. The
+    on-variant's scan-body census rides along so the op-count delta is
+    visible next to the throughput it costs."""
+    out: dict = {}
+    rates = {}
+    for mode in ("off", "on"):
+        solver, replay = build(cfg_mod, capacity=65_536, batch=32,
+                               prioritized=True, pallas=False,
+                               device_per=True, prefill=prefill,
+                               learn_metrics=(mode == "on"))
+        r = time_variant(solver, replay, 32, chunks, warmup, chain=chain)
+        med = float(np.median(r))
+        rates[mode] = med
+        out[f"learn_{mode}_steps_per_s"] = round(med, 2)
+        out[f"learn_{mode}_spread"] = round((max(r) - min(r)) / med, 4)
+        if mode == "on":
+            census = fused_train_census(solver, replay, chain)
+            if census:
+                out["learn_on_train_fusions"] = census["fusion"]
+                out["learn_on_train_convs"] = census["convolution"]
+                out["learn_on_train_copies"] = census["copy"]
+        del solver, replay
+    out["learn_overhead_pct"] = round(
+        100.0 * (rates["off"] - rates["on"]) / rates["off"], 2)
+    # a ratio's run-to-run noise is (to first order) the sum of its two
+    # points' spreads — bench_diff gates against this measured figure
+    out["learn_spread"] = round(
+        out["learn_off_spread"] + out["learn_on_spread"], 4)
+    note(f"learn_metrics overhead: {out['learn_overhead_pct']}% "
+         f"({rates['off']:.1f} -> {rates['on']:.1f} steps/s)")
+    return out
+
+
 def _health_overhead(reps: int = 5, iters: int = 2000) -> dict:
     """Measured cost of the health plane's hot calls (PERF.md §15):
     one monitor ``sample`` of a realistic gauge dict + latency-histogram
@@ -1454,6 +1494,12 @@ def main() -> None:
     note("health_overhead")
     # -- health plane overhead (ISSUE 13, PERF.md §15) --------------------
     out.update(_health_overhead(iters=200 if on_cpu else 2000))
+
+    note("learn_overhead")
+    # -- learning-dynamics plane overhead (ISSUE 16, PERF.md §16) ---------
+    out.update(_learn_overhead(cfg_mod, note, on_cpu=on_cpu,
+                               chain=b32_chain, chunks=chunks * 2,
+                               warmup=warmup, prefill=idle_prefill))
 
     # -- derived ----------------------------------------------------------
     # spread discipline (VERDICT r4 next #5): chained keys must hold

@@ -1290,6 +1290,21 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                                         dispatch_lock=server.replay_lock,
                                         timer=timer)
                         if fused_per else None)
+        # learning-dynamics plane (ISSUE 16): the fused chunks return
+        # one on-device metrics plane per dispatch; fold them into
+        # learn/* gauges + the TD histogram at log cadence and register
+        # the learner itself as a fleet-health member so divergence
+        # trends (loss_divergence & co) land in the fleet verdict
+        learn_acc = learn_monitor = None
+        if cfg.train.learn_metrics and fused_per:
+            from distributed_deep_q_tpu import learning
+            learn_acc = learning.LearnAccumulator()
+            learn_monitor = health.HealthMonitor(
+                rules=health.default_learn_rules(),
+                trends=health.default_learn_trends(), name="learner")
+            fleet_health.register(
+                "learner", learning.learn_scrape_fn(learn_acc,
+                                                    learn_monitor))
         for gstep in range(1, cfg.train.total_steps + 1):
             if fused_per:
                 # the fused chunk flushes staged actor rows + dispatches
@@ -1376,6 +1391,17 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                 # gauges, and the fleet counters actors flushed back
                 infer_tm = (infer_server.telemetry_summary()
                             if infer_server is not None else {})
+                if learn_acc is not None:
+                    # fold this window's planes (D2H happens HERE, at
+                    # log cadence) and surface learn/* + the TD-error
+                    # histogram summary through the metrics spine
+                    for plane in fused_stream.drain_planes():
+                        learn_acc.ingest(plane)
+                    for lk, lv in learn_acc.gauges().items():
+                        metrics.gauge(lk, lv)
+                    for lk, lv in learn_acc.hist_snapshot().summary(
+                            prefix="learn/td_error").items():
+                        metrics.gauge(lk, lv)
                 # health plane: live MFU/ingest-utilization gauges + the
                 # aggregated fleet verdict (scraped every
                 # health.scrape_every log ticks; {} while disabled)

@@ -186,6 +186,14 @@ DEFAULT_REGISTRY = LockRegistry(
         "_members":         Guard("_lock", "FleetHealth"),
         "_scrape_errors":   Guard("_lock", "FleetHealth"),
         "_fleet_verdict":   Guard("_lock", "FleetHealth"),
+        # LearnAccumulator (ISSUE 16): cumulative + window planes and
+        # the cached gauge dict — ``ingest`` runs on the training loop's
+        # dispatch cadence while ``gauges``/``hist_snapshot`` answer the
+        # supervisor log tick and the fleet's health scrape thread
+        "_lm_total":        Guard("_lm_lock", "LearnAccumulator"),
+        "_lm_window":       Guard("_lm_lock", "LearnAccumulator"),
+        "_lm_planes":       Guard("_lm_lock", "LearnAccumulator"),
+        "_lm_last":         Guard("_lm_lock", "LearnAccumulator"),
         # NOTE deliberately unregistered: ReplayFeedServer.last_seen is a
         # GIL-atomic monotonic stamp dict (single-writer per key, reader
         # tolerates staleness); DeviceStager._err is benign once-set.
@@ -199,6 +207,7 @@ DEFAULT_REGISTRY = LockRegistry(
         "distributed_deep_q_tpu/rpc/inference_server.py",
         "distributed_deep_q_tpu/actors/supervisor.py",
         "distributed_deep_q_tpu/health.py",
+        "distributed_deep_q_tpu/learning.py",
         "distributed_deep_q_tpu/replay/staging.py",
         "distributed_deep_q_tpu/replay/columnar.py",
         "distributed_deep_q_tpu/native/__init__.py",

@@ -42,7 +42,7 @@ RULE_SPAN = "metric_keys.unknown-span"
 
 NAMESPACES = ("rpc", "fleet", "queue", "durability", "flow", "trace",
               "learner", "ingest", "inference", "shard", "actor",
-              "health", "train")
+              "health", "train", "learn")
 _NS_RE = re.compile(r"^(?:%s)/.+" % "|".join(NAMESPACES))
 
 EMITTERS = frozenset(
@@ -146,6 +146,25 @@ REGISTRY = frozenset({
     "train/steps_per_s",
     "train/mfu",
     "train/ingest_utilization",
+    # learning-dynamics plane (ISSUE 16): learn/* gauges the on-device
+    # metrics plane accumulates inside the fused-chain / Anakin scan
+    # bodies (learning.LearnAccumulator.gauges) + the TD-|error|
+    # histogram prefix (summary suffixes expand at runtime)
+    "learn/loss",
+    "learn/grad_norm",
+    "learn/grad_norm_clipped",
+    "learn/q_mean",
+    "learn/q_max",
+    "learn/td_mean",
+    "learn/td_max",
+    "learn/prio_mean",
+    "learn/prio_max",
+    "learn/is_weight_mean",
+    "learn/is_weight_min",
+    "learn/target_refreshes",
+    "learn/loss_nonfinite",
+    "learn/steps",
+    "learn/td_error",
 })
 
 _TRACING_REL = os.path.join("distributed_deep_q_tpu", "tracing.py")

@@ -159,6 +159,13 @@ class TrainConfig:
     # store Adam's first moment in bfloat16 (optax mu_dtype): trims
     # optimizer-state HBM traffic on the HBM-bound small-batch step
     adam_mu_dtype: str = "float32"  # float32 | bfloat16
+    # learning-dynamics plane (ISSUE 16, learning.py): accumulate loss /
+    # TD-histogram / grad-norm / Q / PER-sampling statistics INSIDE the
+    # fused-chain and Anakin scan bodies, returned as one flat plane per
+    # dispatch. Static trace-time gate: False compiles the exact pre-PR
+    # programs (bitwise math, unchanged op budgets); True pays the small
+    # documented budget delta (PERF.md §16) and still zero host-comm ops
+    learn_metrics: bool = False
     checkpoint_dir: str = ""
     checkpoint_every: int = 0  # grad steps between Orbax snapshots
     resume: bool = False       # restore newest snapshot before training

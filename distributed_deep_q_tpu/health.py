@@ -42,6 +42,7 @@ __all__ = [
     "verdict_to_wire", "verdict_from_wire",
     "default_server_rules", "default_server_trends",
     "default_inference_rules", "default_inference_trends",
+    "default_learn_rules", "default_learn_trends",
 ]
 
 ENABLED = False  # module flag: the single branch on every hot path
@@ -598,4 +599,35 @@ def default_inference_trends() -> tuple:
     return (
         TrendRule(name="infer_queue_growth", key="inference/queued_rows",
                   kind="monotonic_growth", ratio=2.0, min_points=6),
+    )
+
+
+def default_learn_rules() -> tuple:
+    """Learning-dynamics SLOs (ISSUE 16, ``learning.py``'s ``learn/*``
+    gauges). A non-finite loss is the one hard divergence fact — any
+    sustained rate of NaN/inf steps is critical; everything softer is a
+    trend below."""
+    return (
+        SLORule(name="loss_nonfinite", key="learn/loss_nonfinite",
+                target=0.0, mode="above", budget=0.25,
+                severity="critical"),
+    )
+
+
+def default_learn_trends() -> tuple:
+    """Targetless divergence detectors over the learner's own dynamics.
+    ``loss_divergence`` is the chaos gate's named finding (an lr spike
+    must walk the fleet verdict ok → degraded → ok —
+    scripts/chaos_smoke.py divergence mode)."""
+    return (
+        TrendRule(name="loss_divergence", key="learn/loss",
+                  kind="drift", ratio=5.0, min_points=4),
+        TrendRule(name="loss_collapse", key="learn/loss",
+                  kind="collapse", ratio=0.02, floor=1e-5),
+        TrendRule(name="grad_norm_spike", key="learn/grad_norm",
+                  kind="drift", ratio=10.0, min_points=4),
+        TrendRule(name="q_overestimation", key="learn/q_max",
+                  kind="monotonic_growth", ratio=3.0, min_points=6),
+        TrendRule(name="priority_collapse", key="learn/prio_mean",
+                  kind="collapse", ratio=0.05, floor=1e-5),
     )

@@ -1,0 +1,287 @@
+"""Learning-dynamics plane (ISSUE 16): on-device metric accumulators.
+
+The fused-chain train scan (``parallel/learner.py``) and the Anakin
+superstep (``parallel/anakin.py``) deliberately run with ZERO host
+communication, which made them observability black holes: loss, TD
+error, grad norm, Q-value scale, and the PER sampling distribution were
+invisible exactly where ROADMAP items 2 and 5 need them. This module is
+the bridge — a small flat f32 **metrics plane** that rides the existing
+scan carry, accumulated per grad step with plain ``jnp`` (no
+infeed/outfeed/callback ops, so the Anakin zero-host-comm census still
+holds), finalized ONCE per dispatch with the chunk's collectives, and
+returned as a normal program output the host folds at its own cadence.
+
+Plane layout (one f32 vector, ``PLANE_SIZE`` elements)::
+
+    [0:N_HIST]      TD-|error| log-bucket counts — geometry is an exact
+                    twin of ``metrics.Histogram(TD_LO, TD_HI,
+                    TD_PER_DECADE)`` so the host can pour the counts
+                    straight into the PR 12 merge/delta machinery
+    psum sums       shard-local sums: Σ|TD|, Σ sampled priority
+                    ((|TD|+ε)^α — the scatter_priorities value), Σ IS
+                    weight, sample count
+    repl sums       already-replicated per-step scalars (loss, grad
+                    norm pre/post clip, Q mean, target-refresh count,
+                    non-finite-loss count, step count) — summed as-is,
+                    NOT psum'd again
+    maxes           shard-local extrema: max |TD|, max Q, max priority
+    mins            min IS weight, min |TD|
+
+``lm_finalize`` makes the plane truly replicated (psum the shard-local
+segment, pmax/pmin the extrema) so it can leave the ``shard_map`` under
+an ordinary ``P()`` out-spec. Everything is gated behind the STATIC
+``cfg.train.learn_metrics`` flag: off traces zero extra ops — the
+compiled programs are bitwise identical to pre-PR (pinned by
+tests/test_learning_metrics.py and the test_op_count.py ratchets).
+
+Host side, ``LearnAccumulator`` folds returned planes (cumulative +
+sliding window), rebuilds the TD histogram as a real
+``metrics.Histogram``, and publishes ``learn/*`` gauges that feed the
+PR 12 health plane (``health.default_learn_rules/trends``) and the run
+JSONL.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+import numpy as np
+
+from distributed_deep_q_tpu.metrics import Histogram
+
+# TD-|error| histogram geometry — must stay in lockstep with the host
+# Histogram the accumulator rebuilds (pinned by test_learning_metrics).
+# |TD| for clipped-reward DQN lives overwhelmingly in [1e-3, 1e2]; four
+# buckets/decade over eight decades is enough shape at 34 floats.
+TD_LO = 1e-4
+TD_HI = 1e4
+TD_PER_DECADE = 4
+_LOG_LO = math.log(TD_LO)
+_SCALE = TD_PER_DECADE / math.log(10.0)
+# interior + underflow + overflow — same derivation as Histogram.__init__
+N_HIST = int(math.ceil((math.log(TD_HI) - _LOG_LO) * _SCALE)) + 2
+
+# scalar slots after the histogram segment
+I_TD_SUM = N_HIST + 0        # Σ|TD| over samples          (psum)
+I_PRIO_SUM = N_HIST + 1      # Σ(|TD|+ε)^α                 (psum)
+I_ISW_SUM = N_HIST + 2       # Σ IS weight                 (psum)
+I_SAMPLES = N_HIST + 3       # sample count                (psum)
+I_LOSS_SUM = N_HIST + 4      # Σ loss (already pmean'd)    (replicated)
+I_GNORM_SUM = N_HIST + 5     # Σ grad norm pre-clip        (replicated)
+I_GNORM_CLIP_SUM = N_HIST + 6  # Σ grad norm post-clip     (replicated)
+I_QMEAN_SUM = N_HIST + 7     # Σ Q mean (already pmean'd)  (replicated)
+I_REFRESH = N_HIST + 8       # target-refresh count        (replicated)
+I_NONFINITE = N_HIST + 9     # non-finite-loss step count  (replicated)
+I_STEPS = N_HIST + 10        # grad-step count             (replicated)
+I_TD_MAX = N_HIST + 11       # max |TD|                    (pmax)
+I_Q_MAX = N_HIST + 12        # max Q                       (pmax)
+I_PRIO_MAX = N_HIST + 13     # max sampled priority        (pmax)
+I_ISW_MIN = N_HIST + 14      # min IS weight               (pmin)
+I_TD_MIN = N_HIST + 15       # min |TD|                    (pmin)
+PLANE_SIZE = N_HIST + 16
+
+# segment boundaries for finalize/fold: [0, _REPL) psums, [_REPL, _MAX)
+# rides through replicated, [_MAX, _MIN) pmax, [_MIN, end) pmin
+_REPL = I_LOSS_SUM
+_MAX = I_TD_MAX
+_MIN = I_ISW_MIN
+
+
+# -- device side (pure jnp; traced only when cfg.learn_metrics) -------------
+def lm_init():
+    """Fresh per-dispatch plane: zero sums, ∓inf extrema identities."""
+    import jax.numpy as jnp
+
+    z = jnp.zeros((PLANE_SIZE,), jnp.float32)
+    z = z.at[_MAX:_MIN].set(-jnp.inf)
+    return z.at[_MIN:].set(jnp.inf)
+
+
+def lm_update(plane, *, cfg, td_abs, weight, loss, q, q_mean, gnorm,
+              step, alpha, eps):
+    """Fold one grad step into the plane — elementwise jnp only.
+
+    ``td_abs``/``weight``/``q`` are SHARD-LOCAL per-sample arrays;
+    ``loss``/``q_mean`` arrive already ``pmean``'d (replicated) and
+    ``gnorm`` is computed from the allreduced gradient, so those sums
+    land in the replicated segment that ``lm_finalize`` does NOT psum.
+    ``alpha``/``eps`` are the replay's PER exponent/floor, so the
+    priority statistic is exactly the value ``scatter_priorities``
+    writes back. Non-finite inputs are squashed (``nan_to_num``) so one
+    diverged step cannot poison the whole window — the divergence
+    itself is what ``I_NONFINITE`` counts.
+    """
+    import jax.numpy as jnp
+
+    td = jnp.nan_to_num(td_abs.astype(jnp.float32).reshape(-1),
+                        nan=0.0, posinf=TD_HI * 10.0, neginf=0.0)
+    w = jnp.nan_to_num(weight.astype(jnp.float32).reshape(-1),
+                       nan=0.0, posinf=0.0, neginf=0.0)
+    qf = jnp.nan_to_num(q.astype(jnp.float32), nan=0.0,
+                        posinf=0.0, neginf=0.0)
+    finite = jnp.isfinite(loss)
+    loss_s = jnp.where(finite, loss, 0.0)
+    gnorm_s = jnp.where(jnp.isfinite(gnorm), gnorm, 0.0)
+    qmean_s = jnp.where(jnp.isfinite(q_mean), q_mean, 0.0)
+
+    # log-bucket index — the jnp twin of Histogram.observe (floor ==
+    # int-truncation here: the argument is non-negative once v >= lo)
+    safe = jnp.maximum(td, TD_LO)
+    idx = 1 + jnp.floor(
+        (jnp.log(safe) - _LOG_LO) * _SCALE).astype(jnp.int32)
+    idx = jnp.where(td < TD_LO, 0, jnp.minimum(idx, N_HIST - 1))
+    plane = plane.at[idx].add(1.0)
+
+    prio = (td + eps) ** alpha
+    clip = float(cfg.grad_clip_norm)
+    scale = (jnp.minimum(1.0, clip / jnp.maximum(gnorm_s, 1e-12))
+             if clip > 0 else jnp.float32(1.0))
+    if cfg.target_tau > 0:
+        refresh = jnp.float32(1.0)  # Polyak: every step refreshes
+    else:
+        refresh = (step % cfg.target_update_period == 0).astype(
+            jnp.float32)
+    sums = jnp.stack([
+        jnp.sum(td), jnp.sum(prio), jnp.sum(w),
+        jnp.float32(td.shape[0]),
+        loss_s, gnorm_s, gnorm_s * scale, qmean_s, refresh,
+        1.0 - finite.astype(jnp.float32), jnp.float32(1.0)])
+    plane = plane.at[I_TD_SUM:I_TD_SUM + sums.shape[0]].add(sums)
+    plane = plane.at[_MAX:_MIN].max(
+        jnp.stack([jnp.max(td), jnp.max(qf), jnp.max(prio)]))
+    return plane.at[_MIN:].min(jnp.stack([jnp.min(w), jnp.min(td)]))
+
+
+def lm_finalize(plane, axis):
+    """ONE cross-shard reduction per dispatch, after the scan: psum the
+    shard-local counts/sums, pmax/pmin the extrema, pass the
+    already-replicated segment through — the result is truly replicated
+    and legal under a ``P()`` out-spec."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    return jnp.concatenate([
+        lax.psum(plane[:_REPL], axis), plane[_REPL:_MAX],
+        lax.pmax(plane[_MAX:_MIN], axis), lax.pmin(plane[_MIN:], axis)])
+
+
+# -- host side --------------------------------------------------------------
+def host_plane() -> np.ndarray:
+    """The fold identity, as f64 numpy (counts stay exact far past the
+    f32 2^24 integer ceiling once folded on the host)."""
+    z = np.zeros(PLANE_SIZE, np.float64)
+    z[_MAX:_MIN] = -np.inf
+    z[_MIN:] = np.inf
+    return z
+
+
+def fold_plane(dst: np.ndarray, plane) -> np.ndarray:
+    """Fold one or more returned planes (``[PLANE_SIZE]`` or any
+    leading-dim stack) into ``dst`` in place — sums add, extrema
+    max/min, exactly the device combine."""
+    p = np.asarray(plane, np.float64).reshape(-1, PLANE_SIZE)
+    dst[:_MAX] += p[:, :_MAX].sum(axis=0)
+    np.maximum(dst[_MAX:_MIN], p[:, _MAX:_MIN].max(axis=0),
+               out=dst[_MAX:_MIN])
+    np.minimum(dst[_MIN:], p[:, _MIN:].min(axis=0), out=dst[_MIN:])
+    return dst
+
+
+def plane_histogram(plane: np.ndarray) -> Histogram:
+    """Rebuild the TD-|error| histogram as a real ``metrics.Histogram``
+    — counts poured straight into the PR 12 merge/snapshot/delta
+    machinery, total/extrema restored from the plane's scalar slots."""
+    h = Histogram(TD_LO, TD_HI, TD_PER_DECADE)
+    counts = [int(round(c)) for c in np.asarray(plane[:N_HIST])]
+    assert len(counts) == len(h._counts), "plane/Histogram geometry drift"
+    h._counts = counts
+    h.count = sum(counts)
+    h.total = float(plane[I_TD_SUM])
+    if h.count:
+        h.vmin = float(plane[I_TD_MIN])
+        h.vmax = float(plane[I_TD_MAX])
+    return h
+
+
+class LearnAccumulator:
+    """Host fold of learning-dynamics planes: cumulative totals (the TD
+    histogram the report reads) plus a sliding window that turns into
+    fresh ``learn/*`` gauges each ``gauges()`` call — the per-tick
+    points the health plane's divergence trends compare.
+
+    One lock guards all mutable state: ``ingest`` runs on the training
+    loop's dispatch cadence while ``gauges``/``hist_snapshot`` answer
+    the supervisor's log tick and the fleet's ``health`` scrape thread.
+    """
+
+    def __init__(self):
+        self._lm_lock = threading.Lock()
+        self._lm_total = host_plane()
+        self._lm_window = host_plane()
+        self._lm_planes = 0
+        self._lm_last: dict[str, float] = {}
+
+    def ingest(self, plane) -> None:
+        """Fold one dispatch's returned plane (numpy or device array —
+        conversion happens here, at log cadence, never per step)."""
+        if plane is None:
+            return
+        with self._lm_lock:
+            fold_plane(self._lm_total, plane)
+            fold_plane(self._lm_window, plane)
+            self._lm_planes += 1
+
+    @property
+    def planes(self) -> int:
+        with self._lm_lock:
+            return self._lm_planes
+
+    def hist_snapshot(self) -> Histogram:
+        """Cumulative TD histogram — monotone, so ``HealthMonitor``'s
+        snapshot/delta windowing applies unchanged."""
+        with self._lm_lock:
+            return plane_histogram(self._lm_total)
+
+    def gauges(self) -> dict[str, float]:
+        """Drain the window into one flat ``learn/*`` gauge dict; with
+        no new planes since the last call the previous gauges are
+        re-published (a stalled learner should hold its last readings,
+        not flap to zero)."""
+        with self._lm_lock:
+            w = self._lm_window
+            steps = w[I_STEPS]
+            if steps <= 0:
+                return dict(self._lm_last)
+            samples = max(w[I_SAMPLES], 1.0)
+            out = {
+                "learn/loss": w[I_LOSS_SUM] / steps,
+                "learn/grad_norm": w[I_GNORM_SUM] / steps,
+                "learn/grad_norm_clipped": w[I_GNORM_CLIP_SUM] / steps,
+                "learn/q_mean": w[I_QMEAN_SUM] / steps,
+                "learn/q_max": w[I_Q_MAX],
+                "learn/td_mean": w[I_TD_SUM] / samples,
+                "learn/td_max": w[I_TD_MAX],
+                "learn/prio_mean": w[I_PRIO_SUM] / samples,
+                "learn/prio_max": w[I_PRIO_MAX],
+                "learn/is_weight_mean": w[I_ISW_SUM] / samples,
+                "learn/is_weight_min": w[I_ISW_MIN],
+                "learn/target_refreshes": w[I_REFRESH],
+                "learn/loss_nonfinite": w[I_NONFINITE],
+                "learn/steps": self._lm_total[I_STEPS],
+            }
+            out = {k: float(v) for k, v in out.items()}
+            self._lm_window = host_plane()
+            self._lm_last = out
+            return dict(out)
+
+
+def learn_scrape_fn(acc: LearnAccumulator, monitor):
+    """The learner's fleet-member ``health`` endpoint: sample the
+    accumulator's gauges + TD-histogram snapshot into ``monitor`` and
+    answer the wire verdict — the same closure shape ``FleetHealth``
+    registers for the in-process replay member."""
+    def _scrape() -> dict:
+        return monitor.scrape(acc.gauges(),
+                              {"learn/td_error": acc.hist_snapshot()})
+    return _scrape

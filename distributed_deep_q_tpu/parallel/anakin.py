@@ -63,7 +63,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from distributed_deep_q_tpu import tracing
+from distributed_deep_q_tpu import learning, tracing
 from distributed_deep_q_tpu.compat import shard_map
 from distributed_deep_q_tpu.config import Config
 from distributed_deep_q_tpu.models.qnet import stacked_q_apply
@@ -332,7 +332,10 @@ class AnakinRunner:
 
             # -- train scan: the plane-carry body (plane_train_fn twin) ---
             def train_body(c, xs):
-                pt, m, v, cnt, gstep, prio, maxp = c
+                if cfg_t.learn_metrics:
+                    pt, m, v, cnt, gstep, prio, maxp, lmp = c
+                else:
+                    pt, m, v, cnt, gstep, prio, maxp = c
                 batch, w_, idx = xs
                 batch = dict(batch)
                 ovalid = batch.pop("ovalid")
@@ -368,11 +371,30 @@ class AnakinRunner:
                                                 alpha, p_eps)
                 metrics = {"loss": loss, "q_mean": q_mean,
                            "grad_norm": gnorm}
+                if cfg_t.learn_metrics:
+                    # learning-dynamics plane (learning.py): jnp-only
+                    # accumulation, so the zero-host-comm census pin
+                    # holds with the gate on (test_op_count)
+                    lmp = learning.lm_update(
+                        lmp, cfg=cfg_t, td_abs=td_abs,
+                        weight=batch["weight"], loss=loss, q=q,
+                        q_mean=q_mean, gnorm=gnorm, step=step2,
+                        alpha=alpha, eps=p_eps)
+                    return (pt, m, v, cnt, step2, prio, maxp, lmp), \
+                        metrics
                 return (pt, m, v, cnt, step2, prio, maxp), metrics
 
             carry0 = (pt, m, v, cnt, gstep, prio, ds.maxp)
-            (pt, m, v, cnt, gstep, prio, maxp), metrics = lax.scan(
-                train_body, carry0, (metas, win, idxs))
+            if cfg_t.learn_metrics:
+                carry0 = carry0 + (learning.lm_init(),)
+                (pt, m, v, cnt, gstep, prio, maxp, lmp), metrics = \
+                    lax.scan(train_body, carry0, (metas, win, idxs))
+                metrics = dict(metrics)
+                metrics["learn_plane"] = learning.lm_finalize(
+                    lmp, AXIS_DP)
+            else:
+                (pt, m, v, cnt, gstep, prio, maxp), metrics = lax.scan(
+                    train_body, carry0, (metas, win, idxs))
 
             ds = DeviceReplayState(
                 frames=frames, action=action, reward=reward, done=done,
@@ -388,6 +410,9 @@ class AnakinRunner:
         carry_spec = (state_spec, self._env_spec, S, S, S, S,
                       P(), P(), P(), P(), P())
         metric_spec = {"loss": P(), "q_mean": P(), "grad_norm": P()}
+        if cfg_t.learn_metrics:
+            # the finalized plane is replicated (lm_finalize's psums)
+            metric_spec["learn_plane"] = P()
         return jax.jit(
             shard_map(superstep_body, mesh=mesh,
                       in_specs=(carry_spec, S, S, P()),

@@ -30,7 +30,7 @@ import numpy as np
 import optax
 from jax import lax
 
-from distributed_deep_q_tpu import tracing
+from distributed_deep_q_tpu import learning, tracing
 from distributed_deep_q_tpu.compat import safe_increment, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -698,7 +698,10 @@ class Learner:
             v = tree_to_plane(adam_state.nu)
 
             def body(carry, xs):
-                pt, m, v, cnt, step, prio, maxp = carry
+                if cfg.learn_metrics:
+                    pt, m, v, cnt, step, prio, maxp, lmp = carry
+                else:
+                    pt, m, v, cnt, step, prio, maxp = carry
                 batch, w, idx = xs
                 batch = unpack_batch(batch, w)
                 step2 = step + 1
@@ -728,11 +731,34 @@ class Learner:
                                                 alpha, eps)
                 metrics = {"loss": loss, "q_mean": q_mean,
                            "grad_norm": gnorm}
+                if cfg.learn_metrics:
+                    # learning-dynamics plane (learning.py): pure-jnp
+                    # accumulation into the carry — the training math
+                    # above is untouched, so the gate-off path stays
+                    # bitwise identical (test_learning_metrics)
+                    lmp = learning.lm_update(
+                        lmp, cfg=cfg, td_abs=td_abs,
+                        weight=batch["weight"], loss=loss, q=q,
+                        q_mean=q_mean, gnorm=gnorm, step=step2,
+                        alpha=alpha, eps=eps)
+                    return (pt, m, v, cnt, step2, prio, maxp, lmp), \
+                        metrics
                 return (pt, m, v, cnt, step2, prio, maxp), metrics
 
             carry0 = (pt, m, v, adam_state.count, state.step, prio, maxp)
-            (pt, m, v, cnt, step, prio, maxp), metrics = lax.scan(
-                body, carry0, (metas, win, idxs))
+            if cfg.learn_metrics:
+                carry0 = carry0 + (learning.lm_init(),)
+                (pt, m, v, cnt, step, prio, maxp, lmp), metrics = \
+                    lax.scan(body, carry0, (metas, win, idxs))
+                metrics = dict(metrics)
+                # ONE cross-shard reduction per dispatch, outside the
+                # scan; replicated, so the trailing P() out-spec covers
+                # the new dict leaf unchanged
+                metrics["learn_plane"] = learning.lm_finalize(
+                    lmp, AXIS_DP)
+            else:
+                (pt, m, v, cnt, step, prio, maxp), metrics = lax.scan(
+                    body, carry0, (metas, win, idxs))
             params, target_params = plane_to_param_trees(
                 meta, pt, state.params, state.target_params)
             new_opt = rebuild(adam_state._replace(

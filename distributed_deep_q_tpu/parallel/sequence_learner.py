@@ -37,6 +37,7 @@ from jax import lax
 from distributed_deep_q_tpu.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distributed_deep_q_tpu import learning
 from distributed_deep_q_tpu.config import ReplayConfig, TrainConfig
 from distributed_deep_q_tpu.models.qnet import (
     r2d2_burn_carry, r2d2_param_split, r2d2_recur, stacked_r2d2_features)
@@ -182,6 +183,12 @@ class SequenceLearner:
                 "q_mean": q_mean,
                 "grad_norm": gnorm,
             }
+            if cfg.learn_metrics:
+                # learning-dynamics plane (learning.py): the recurrent
+                # step's Q extreme, reduced here so the fused chain's
+                # plane sees a replicated scalar (lm_finalize's pmax is
+                # then idempotent). Static gate — off traces nothing.
+                metrics["q_max"] = lax.pmax(jnp.max(q), AXIS_DP)
             return new_state, metrics, priority
 
         return step_fn(state, batch)
@@ -322,9 +329,13 @@ class SequenceLearner:
 
         def train_fn(state: TrainState, metas, win, idxs, prio, maxp):
             h, wd = frame_shape
+            lm = bool(self.cfg.learn_metrics)  # static trace-time gate
 
             def body(carry, xs):
-                state, prio, maxp = carry
+                if lm:
+                    state, prio, maxp, lmp = carry
+                else:
+                    state, prio, maxp = carry
                 batch, block, idx = xs
                 batch = dict(batch)
                 obs = compose_sequence_block(block, batch["mask"],
@@ -334,10 +345,30 @@ class SequenceLearner:
                 state, metrics, priority = self._step_core(state, batch)
                 prio, maxp = scatter_priorities(prio, maxp, idx, priority,
                                                 alpha, eps)
+                if lm:
+                    # per-sequence mixed max/mean |TD| (the PER priority
+                    # statistic of record on the R2D2 path) feeds the TD
+                    # histogram; loss/q_mean/gnorm arrive pmean'd from
+                    # _step_core, q_max already pmax'd (idempotent under
+                    # lm_finalize's pmax)
+                    lmp = learning.lm_update(
+                        lmp, cfg=self.cfg, td_abs=priority,
+                        weight=batch["weight"], loss=metrics["loss"],
+                        q=metrics["q_max"], q_mean=metrics["q_mean"],
+                        gnorm=metrics["grad_norm"], step=state.step,
+                        alpha=alpha, eps=eps)
+                    return (state, prio, maxp, lmp), metrics
                 return (state, prio, maxp), metrics
 
-            (state, prio, maxp), metrics = lax.scan(
-                body, (state, prio, maxp), (metas, win, idxs))
+            if lm:
+                (state, prio, maxp, lmp), metrics = lax.scan(
+                    body, (state, prio, maxp, learning.lm_init()),
+                    (metas, win, idxs))
+                metrics = dict(metrics)
+                metrics["learn_plane"] = learning.lm_finalize(lmp, AXIS_DP)
+            else:
+                (state, prio, maxp), metrics = lax.scan(
+                    body, (state, prio, maxp), (metas, win, idxs))
             return state, prio, maxp, metrics
 
         # donate every input with an updated output to alias (transition

@@ -159,7 +159,13 @@ class Solver:
         back nothing (replay/device_per.py). Metrics come back as device
         scalars."""
         m = self.train_steps_device_per(replay, chain=1)
-        return {k: v[0] for k, v in m.items()}
+        # the learning-dynamics plane is per-DISPATCH (no chain axis) —
+        # it must not be sliced like the per-step metric rows
+        plane = m.pop("learn_plane", None)
+        out = {k: v[0] for k, v in m.items()}
+        if plane is not None:
+            out["learn_plane"] = plane
+        return out
 
     def train_steps_device_per(self, replay,
                                chain: int | None = None) -> dict[str, Any]:
@@ -268,6 +274,18 @@ class FusedStepStream:
         self._chunk: dict[str, Any] | None = None
         self._len = 0
         self._pending = 0
+        # learning-dynamics planes (cfg.train.learn_metrics): one device
+        # array per dispatched chunk, popped out of the chunk so the
+        # per-step row slicing below never sees the odd-shaped leaf;
+        # drained by the train loop at log cadence (drain_planes)
+        self._planes: list[Any] = []
+
+    def drain_planes(self) -> list[Any]:
+        """Hand back (and clear) the accumulated learning-dynamics
+        planes — still device arrays; the caller converts when folding
+        (``LearnAccumulator.ingest``), at log cadence, never per step."""
+        out, self._planes = self._planes, []
+        return out
 
     def next(self, steps_left: int) -> dict[str, Any]:
         """Metrics for one grad step; dispatches a fresh chunk as needed.
@@ -286,6 +304,9 @@ class FusedStepStream:
             with self._lock, phase:
                 self._chunk = self._solver.train_steps_device_per(
                     self._replay, chain=self._len)
+            plane = self._chunk.pop("learn_plane", None)
+            if plane is not None:
+                self._planes.append(plane)
             self._pending = self._len
         m = {k: v[self._len - self._pending]
              for k, v in self._chunk.items()}

@@ -177,6 +177,13 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
     timer = StepTimer()
     fused_stream = (FusedStepStream(solver, replay, cfg.replay.fused_chain,
                                     timer=timer) if fused_per else None)
+    # learning-dynamics plane (ISSUE 16): in-process loop folds the
+    # fused chunks' returned planes into learn/* gauges at log cadence
+    # (the health-plane registration is the distributed supervisor's job)
+    learn_acc = None
+    if cfg.train.learn_metrics and fused_per:
+        from distributed_deep_q_tpu import learning
+        learn_acc = learning.LearnAccumulator()
     trace = TraceWindow(cfg.train.profile_dir, cfg.train.profile_start_step,
                         cfg.train.profile_num_steps)
     if cfg.train.profile_port:
@@ -292,6 +299,17 @@ def train_single_process(cfg: Config, metrics: Metrics | None = None,
                         pending = getattr(replay, "pending_rows", None)
                         if pending is not None:
                             metrics.gauge("queue/staged_rows", pending())
+                        if learn_acc is not None:
+                            # D2H of the window's planes happens here, at
+                            # log cadence — never on the step path
+                            for plane in fused_stream.drain_planes():
+                                learn_acc.ingest(plane)
+                            for lk, lv in learn_acc.gauges().items():
+                                metrics.gauge(lk, lv)
+                            for lk, lv in learn_acc.hist_snapshot(
+                                    ).summary(
+                                    prefix="learn/td_error").items():
+                                metrics.gauge(lk, lv)
                         metrics.log(solver.step, **summary, **timer.summary(),
                                     **metrics.telemetry())
 

@@ -46,6 +46,11 @@ SPREAD_KEY = {
     "health_verdict_us": "health_spread",
     "health_disabled_us": "health_spread",
     "mfu_live": "flagship_spread",
+    # learn_metrics on-vs-off overhead (ISSUE 16): the pct divides two
+    # timed points, so its noise is the sum of their spreads — recorded
+    # as learn_spread (learn_off/on_steps_per_s follow the automatic
+    # "<prefix>_spread" convention and need no entry here)
+    "learn_overhead_pct": "learn_spread",
 }
 
 # substrings marking metrics where UP is the bad direction
@@ -53,7 +58,7 @@ SPREAD_KEY = {
 # replay traffic is a sharding violation, so up must gate, and the
 # common old=0 case makes any appearance an infinite regression)
 _LOWER_BETTER = ("_ms", "_fusions", "_convs", "_copies", "fusions",
-                 "spread", "_rpcs", "_us")
+                 "spread", "_rpcs", "_us", "overhead_pct")
 # keys that are configuration echoes / identities, not metrics
 # (max_in_flight_rows is the writers' backpressure watermark — a state
 # echo of the pacing loop, not a quality axis with a bad direction;

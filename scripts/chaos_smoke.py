@@ -1,6 +1,6 @@
 """Chaos smoke — prove the RPC fault-tolerance stack end to end.
 
-Eight modes:
+Nine modes:
 
 ``python scripts/chaos_smoke.py [num_actors] [spec]`` (default)
     Threaded actor fleet over the production wire protocol: resilient
@@ -69,6 +69,18 @@ Eight modes:
     page as critical), and the per-tick ``health/verdict`` JSONL the
     run writes passes ``telemetry_report``'s strict SLO checks after
     recovery.
+
+``python scripts/chaos_smoke.py learn [spike]``
+    Learning-divergence acceptance (ISSUE 16): a synthetic learner
+    feeds learning-dynamics planes (``learning.py`` layout) through the
+    unmodified production read path — ``LearnAccumulator`` fold,
+    ``learn/*`` gauges, divergence ``TrendRule``s, ``FleetHealth``. A
+    mid-run lr spike (multiplicative loss/grad-norm growth per step)
+    must flip the fleet verdict ok → degraded with ``loss_divergence``
+    named; restoring the lr must walk it back to a STABLE ok. The gate:
+    the full arc, zero critical flaps, schema-valid verdict JSONL, and
+    ``telemetry_report``'s strict learn gate still catching the
+    recovered divergence.
 
 ``python scripts/chaos_smoke.py durability [cycles] [spec]``
     Crash-recovery acceptance (ISSUE 6): the server is hard-killed at
@@ -968,6 +980,172 @@ def run_health_smoke(spec: str = "corrupt=0.35,seed=41",
     return verdict
 
 
+def run_learn_divergence_smoke(spike: float = 3.0,
+                               deadline: float = 45.0) -> dict:
+    """Simulated lr spike drives the learner verdict ok → degraded
+    (``loss_divergence`` named) → ok, with hysteresis and no
+    false-critical flaps.
+
+    The learning-dynamics plane is synthesized host-side in exactly the
+    layout the device returns (``learning.py``; TD counts bucketed by a
+    real ``metrics.Histogram`` so the geometry twin is exercised, not
+    re-derived): a stable learner, then a mid-run lr spike modeled as
+    multiplicative loss/grad-norm growth per grad step — the signature
+    of a step size past the stability edge — then recovery. The full
+    production read path runs unmodified: ``LearnAccumulator`` fold →
+    ``learn/*`` gauges → ``HealthMonitor`` divergence trends →
+    ``FleetHealth`` aggregation → JSONL verdicts → the telemetry
+    report's strict learn gate. Windows are shrunk to fractions of a
+    second (production keeps minutes); the trend math is identical."""
+    from distributed_deep_q_tpu import health, learning
+    from distributed_deep_q_tpu.metrics import Histogram, Metrics
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts"))
+    from telemetry_report import (
+        learn_problems, load_records, slo_problems)
+
+    health.configure(enabled=True, fast_window_s=0.5, slow_window_s=1.5,
+                     clear_ratio=0.5)
+    jsonl = tempfile.mktemp(prefix="learn_smoke_", suffix=".jsonl")
+    metrics = Metrics(jsonl_path=jsonl)
+    acc = learning.LearnAccumulator()
+    monitor = health.HealthMonitor(rules=health.default_learn_rules(),
+                                   trends=health.default_learn_trends(),
+                                   name="learner")
+    fleet = health.FleetHealth()
+    fleet.register("learner", learning.learn_scrape_fn(acc, monitor))
+
+    rng = np.random.default_rng(7)
+    state = {"loss": 1.0, "gnorm": 2.0}
+
+    def synth_plane() -> np.ndarray:
+        td = rng.lognormal(mean=0.0, sigma=0.5, size=64)
+        w = rng.uniform(0.3, 1.0, 64)
+        prio = (td + 1e-6) ** 0.6
+        h = Histogram(learning.TD_LO, learning.TD_HI,
+                      learning.TD_PER_DECADE)
+        h.observe_many(td)
+        p = np.zeros(learning.PLANE_SIZE)
+        p[:learning.N_HIST] = h._counts
+        p[learning.I_TD_SUM] = td.sum()
+        p[learning.I_PRIO_SUM] = prio.sum()
+        p[learning.I_ISW_SUM] = w.sum()
+        p[learning.I_SAMPLES] = td.size
+        p[learning.I_LOSS_SUM] = state["loss"]
+        p[learning.I_GNORM_SUM] = state["gnorm"]
+        p[learning.I_GNORM_CLIP_SUM] = min(state["gnorm"], 10.0)
+        p[learning.I_QMEAN_SUM] = 0.5
+        p[learning.I_STEPS] = 1.0
+        p[learning.I_TD_MAX] = td.max()
+        p[learning.I_Q_MAX] = 1.0
+        p[learning.I_PRIO_MAX] = prio.max()
+        p[learning.I_ISW_MIN] = w.min()
+        p[learning.I_TD_MIN] = td.min()
+        return p
+
+    step = [0]
+    statuses: list[str] = []
+    critical_flaps = [0]
+    rules_fired: set[str] = set()
+
+    def tick(collect_rules: bool = False) -> None:
+        acc.ingest(synth_plane())
+        v = fleet.scrape()
+        statuses.append(v.status)
+        if v.status == "critical":
+            critical_flaps[0] += 1
+        if collect_rules and v.status != "ok":
+            rules_fired.update(f.rule for f in v.findings)
+        metrics.log(step[0], **{**fleet.gauges(), **acc.gauges(),
+                                "health/verdict": v.to_jsonable()})
+        step[0] += 1
+        time.sleep(0.03)
+
+    def run_until(pred, min_s: float = 0.0, max_s: float = 15.0,
+                  collect_rules: bool = False, pre=None) -> bool:
+        t0 = time.monotonic()
+        while True:
+            if pre is not None:
+                pre()
+            tick(collect_rules)
+            elapsed = time.monotonic() - t0
+            if elapsed >= min_s and pred():
+                return True
+            if elapsed > max_s:
+                return False
+
+    t0 = time.perf_counter()
+    max_s = deadline / 3
+    # phase A: a healthy learner must settle on ok with warmed rings
+    phase_a_ok = run_until(lambda: statuses[-1] == "ok",
+                           min_s=1.0, max_s=max_s)
+
+    # phase B: the lr spike — loss and grad norm grow multiplicatively
+    # per grad step. The phase gate demands the drift rule ITSELF:
+    # degraded with loss_divergence named in the findings.
+    def spiked() -> None:
+        state["loss"] = min(state["loss"] * spike, 1e6)
+        state["gnorm"] = min(state["gnorm"] * spike, 1e6)
+
+    degraded_reached = run_until(
+        lambda: statuses[-1] == "degraded"
+        and "loss_divergence" in rules_fired,
+        max_s=max_s, collect_rules=True, pre=spiked)
+
+    # phase C: lr restored — loss returns to scale, the trend windows
+    # cool, and the verdict must walk back to a STABLE ok (three
+    # consecutive ok ticks, so a flapping clear fails the phase)
+    state["loss"], state["gnorm"] = 1.0, 2.0
+    recovered = run_until(
+        lambda: len(statuses) >= 3 and statuses[-3:] == ["ok"] * 3,
+        min_s=0.5, max_s=max_s)
+    wall = time.perf_counter() - t0
+
+    metrics.close()
+    health.reset()
+
+    # JSONL must carry schema-valid verdicts; the run ended ok so the
+    # generic SLO gate passes — but the STRICT learn gate must still
+    # catch the transient divergence (recovered-but-diverged is not a
+    # clean training run)
+    records = load_records(jsonl)
+    verdicts = [r["health/verdict"] for r in records
+                if isinstance(r.get("health/verdict"), dict)]
+    schema_ok = bool(verdicts) and all(
+        v.get("status") in ("ok", "degraded", "critical")
+        and isinstance(v.get("ok"), bool)
+        and isinstance(v.get("findings"), list)
+        and all(isinstance(f, dict) and "rule" in f and "key" in f
+                and "severity" in f for f in v["findings"])
+        for v in verdicts)
+    slo = slo_problems(records)
+    strict = learn_problems(records)
+    strict_catches = any("loss_divergence" in p for p in strict)
+
+    verdict = {
+        "ok": (phase_a_ok and degraded_reached and recovered
+               and critical_flaps[0] == 0
+               and "loss_divergence" in rules_fired
+               and schema_ok and not slo and strict_catches),
+        "phase_a_ok": phase_a_ok,
+        "degraded_reached": degraded_reached,
+        "recovered": recovered,
+        "critical_flaps": critical_flaps[0],
+        "rules_fired": sorted(rules_fired),
+        "strict_gate_catches_divergence": strict_catches,
+        "learn_planes_folded": acc.planes,
+        "scrapes": step[0],
+        "jsonl_records": len(records),
+        "verdicts_logged": len(verdicts),
+        "verdict_schema_ok": schema_ok,
+        "slo_problems": slo,
+        "lr_spike_factor": spike,
+        "wall_s": round(wall, 2),
+    }
+    return verdict
+
+
 def run_durability_smoke(cycles: int = 20, num_actors: int = 3,
                          flushes_per_cycle: int = 4, rows: int = 8,
                          spec: str = "torn=0.35,corrupt=0.03,seed=23",
@@ -1185,6 +1363,13 @@ if __name__ == "__main__":
     if args and args[0] in ("health", "--health"):
         verdict = run_health_smoke(
             spec=args[1] if len(args) > 1 else "corrupt=0.35,seed=41")
+        print(json.dumps(verdict))
+        sys.exit(0 if verdict["ok"] else 1)
+    if args and args[0] in ("learn", "--learn", "divergence"):
+        kwargs = {}
+        if len(args) > 1:
+            kwargs["spike"] = float(args[1])
+        verdict = run_learn_divergence_smoke(**kwargs)
         print(json.dumps(verdict))
         sys.exit(0 if verdict["ok"] else 1)
     if args and args[0] in ("durability", "--durability"):

@@ -5,6 +5,12 @@ Reads the JSONL a ``Metrics(jsonl_path=...)`` run wrote and prints:
 
 - run overview — record/step span, wall time, throughput counters;
 - training curve tail — loss / q_mean / return at the end of the run;
+- learning dynamics — the ``learn/*`` gauges the on-device metrics
+  plane accumulated inside the fused-chain / Anakin scan bodies
+  (loss, grad norm pre/post clip, Q scale, PER priority and IS-weight
+  statistics) plus the TD-|error| histogram percentiles; under
+  ``--strict`` any learn divergence finding in a fleet verdict fails
+  the gate even if the run later recovered;
 - per-phase step breakdown — ``time_<phase>_ms`` means plus the
   streaming-histogram p50/p99 where the run recorded them;
 - RPC server table — per-method call counts, latency percentiles and
@@ -125,6 +131,37 @@ def slo_problems(records: list[dict]) -> list[str]:
     return out
 
 
+# findings the learning-dynamics monitor emits (health.default_learn_
+# rules/trends over the learn/* plane). ``--strict`` treats ANY such
+# finding as a failure even if the fleet later recovered: a loss that
+# diverged and came back still trained on poisoned updates, so the run
+# is not a clean gate.
+LEARN_DIVERGENCE_RULES = (
+    "loss_divergence", "loss_collapse", "grad_norm_spike",
+    "q_overestimation", "priority_collapse", "loss_nonfinite")
+
+
+def learn_problems(records: list[dict]) -> list[str]:
+    """Learning-dynamics failures ``--strict`` gates on: any fleet
+    verdict carrying a learn divergence finding, or a run whose last
+    window still counted non-finite losses."""
+    hits: dict[str, int] = {}
+    for v in _verdicts(records):
+        for f in v.get("findings") or []:
+            if isinstance(f, dict) \
+                    and str(f.get("rule")) in LEARN_DIVERGENCE_RULES:
+                r = str(f.get("rule"))
+                hits[r] = hits.get(r, 0) + 1
+    out = [f"learning: divergence finding '{rule}' in {n} verdict(s)"
+           for rule, n in sorted(hits.items())]
+    nf = [v for v in _series(records, "learn/loss_nonfinite")
+          if isinstance(v, (int, float))]
+    if nf and nf[-1] > 0:
+        out.append(f"learning: {int(nf[-1])} non-finite loss step(s) in "
+                   "the final window")
+    return out
+
+
 def _hist_groups(records: list[dict], prefix: str) -> dict[str, dict]:
     """Latest value per histogram-summary group under ``prefix``:
     ``{'fleet/param_pull_ms': {'count': ..., 'p50': ..., ...}, ...}``."""
@@ -217,6 +254,30 @@ def render_report(records: list[dict], last: int = 0) -> str:
             rows.append((key, vals[0], vals[-1], min(vals), max(vals)))
     _table("training curve", rows, ("metric", "first", "last", "min", "max"),
            out)
+
+    # learning dynamics: the learn/* gauges the on-device metrics plane
+    # accumulated inside the fused-chain / Anakin scan bodies
+    # (learning.py), plus the cumulative TD-|error| histogram summary.
+    # Runs without cfg.train.learn_metrics log none of these keys.
+    rows = []
+    for key in ("learn/loss", "learn/grad_norm", "learn/grad_norm_clipped",
+                "learn/q_mean", "learn/q_max", "learn/td_mean",
+                "learn/td_max", "learn/prio_mean", "learn/prio_max",
+                "learn/is_weight_mean", "learn/is_weight_min",
+                "learn/target_refreshes", "learn/loss_nonfinite",
+                "learn/steps"):
+        vals = [v for v in _series(records, key)
+                if isinstance(v, (int, float)) and math.isfinite(v)]
+        if vals:
+            rows.append((key[6:], vals[0], vals[-1], min(vals), max(vals)))
+    _table("learning dynamics (learn/*)", rows,
+           ("gauge", "first", "last", "min", "max"), out)
+    rows = [(name[6:], d.get("count"), d.get("p50"), d.get("p95"),
+             d.get("p99"), d.get("max"))
+            for name, d in sorted(
+                _hist_groups(records, "learn/td_error").items())]
+    _table("TD |error| (sampled-priority distribution)", rows,
+           ("histogram", "count", "p50", "p95", "p99", "max"), out)
 
     # per-phase step breakdown: time_<phase>_ms (+ _p50_ms/_p99_ms)
     phases: dict[str, dict] = {}
@@ -342,7 +403,7 @@ def render_report(records: list[dict], last: int = 0) -> str:
                     f"on {f.get('key', '?')}")
 
     problems = (validate_records(records) + _gap_anomalies(records)
-                + slo_problems(records))
+                + slo_problems(records) + learn_problems(records))
     drops = [v for v in _series(records, "trace/spans_dropped")
              if isinstance(v, (int, float))]
     if drops and drops[-1] > 0:
@@ -376,7 +437,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.strict:
         window = records[-args.last:] if args.last else records
         problems = (validate_records(window) + _gap_anomalies(window)
-                    + slo_problems(window))
+                    + slo_problems(window) + learn_problems(window))
         if problems:
             print(f"strict: FAILED ({len(problems)} problem(s), first: "
                   f"{problems[0]})", file=sys.stderr)

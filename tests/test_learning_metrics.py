@@ -1,0 +1,335 @@
+"""Learning-dynamics plane tests (ISSUE 16, ``learning.py``).
+
+Three bars carry the metrics plane:
+
+1. **Geometry twin.** The device-side log-bucket math (``lm_update``,
+   pure jnp) must land every |TD| sample in the SAME bucket as the host
+   ``metrics.Histogram`` — counts poured back through
+   ``plane_histogram`` must reproduce the host histogram exactly, so
+   the PR 12 merge/delta/percentile machinery reads true numbers.
+
+2. **Off is free.** With ``cfg.train.learn_metrics`` False (the
+   default) the fused transition chain and the Anakin superstep must be
+   BITWISE identical to the plane-carrying build — params, optimizer
+   state, ring contents, priorities. The flag is a static trace-time
+   gate; off traces zero extra ops (op budgets pinned separately in
+   test_op_count.py).
+
+3. **The host fold feeds health.** ``LearnAccumulator`` window/total
+   semantics, gauge naming, and the divergence trends
+   (``health.default_learn_trends``) that turn a loss spike into a
+   named ``loss_divergence`` finding.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from distributed_deep_q_tpu import health, learning
+from distributed_deep_q_tpu.config import (
+    ActorConfig, Config, EnvConfig, MeshConfig, NetConfig, ReplayConfig,
+    TrainConfig)
+from distributed_deep_q_tpu.metrics import Histogram
+
+
+def _host_hist() -> Histogram:
+    return Histogram(learning.TD_LO, learning.TD_HI,
+                     learning.TD_PER_DECADE)
+
+
+# -- geometry / bucketing twin ----------------------------------------------
+def test_plane_geometry_matches_host_histogram():
+    assert learning.N_HIST == len(_host_hist()._counts)
+    assert learning.PLANE_SIZE == learning.N_HIST + 16
+
+
+def test_lm_update_buckets_match_host_observe():
+    """One ``lm_update`` over a sweep spanning underflow, interior, and
+    overflow must produce the host ``Histogram.observe`` counts bucket
+    for bucket, and ``plane_histogram`` must round-trip them into a
+    Histogram whose summary stats match the host's."""
+    rng = np.random.default_rng(7)
+    # values EXACTLY on a bucket edge are one-ULP ambiguous between the
+    # device's f32 log math and the host's f64 — the sweep probes just
+    # inside the edges instead (plus real under/overflow)
+    td = np.concatenate([
+        [0.0, learning.TD_LO / 10.0, learning.TD_LO * 1.01,
+         learning.TD_HI * 0.99, learning.TD_HI * 50.0],
+        rng.lognormal(0.0, 3.0, 251)]).astype(np.float32)
+
+    plane = learning.lm_update(
+        learning.lm_init(), cfg=TrainConfig(),
+        td_abs=jnp.asarray(td), weight=jnp.ones(td.shape, jnp.float32),
+        loss=jnp.float32(0.5), q=jnp.asarray([1.0, 2.0], jnp.float32),
+        q_mean=jnp.float32(1.5), gnorm=jnp.float32(2.0),
+        step=jnp.int32(1), alpha=0.6, eps=1e-6)
+    p = np.asarray(plane, np.float64)
+
+    host = _host_hist()
+    host.observe_many(td.astype(np.float64))
+    np.testing.assert_array_equal(p[:learning.N_HIST],
+                                  np.asarray(host._counts, np.float64))
+
+    assert p[learning.I_SAMPLES] == len(td)
+    np.testing.assert_allclose(p[learning.I_TD_SUM], td.sum(), rtol=1e-5)
+    assert p[learning.I_TD_MAX] == td.max()
+    assert p[learning.I_TD_MIN] == td.min()
+    assert p[learning.I_ISW_MIN] == 1.0
+    assert p[learning.I_STEPS] == 1.0
+
+    rebuilt = learning.plane_histogram(p)
+    assert rebuilt.count == host.count
+    assert rebuilt.vmin == host.vmin and rebuilt.vmax == host.vmax
+    for q in (0.5, 0.95, 0.99):
+        np.testing.assert_allclose(rebuilt.percentile(q),
+                                   host.percentile(q), rtol=1e-6)
+
+
+def test_lm_update_squashes_nonfinite_and_counts_them():
+    """NaN/inf inputs must not poison the plane: sums stay finite, the
+    bad loss step lands in ``I_NONFINITE``, and an infinite |TD| is
+    squashed into the overflow bucket rather than propagating."""
+    plane = learning.lm_update(
+        learning.lm_init(), cfg=TrainConfig(),
+        td_abs=jnp.asarray([np.nan, np.inf, 0.5], jnp.float32),
+        weight=jnp.asarray([np.nan, 1.0, 1.0], jnp.float32),
+        loss=jnp.float32(np.nan), q=jnp.asarray([np.inf, 1.0], jnp.float32),
+        q_mean=jnp.float32(np.inf), gnorm=jnp.float32(np.nan),
+        step=jnp.int32(1), alpha=0.6, eps=1e-6)
+    p = np.asarray(plane)
+    assert np.isfinite(p).all()
+    assert p[learning.I_NONFINITE] == 1.0
+    assert p[learning.I_LOSS_SUM] == 0.0       # squashed, not summed
+    assert p[learning.I_GNORM_SUM] == 0.0
+    assert p[learning.N_HIST - 1] >= 1.0       # inf TD -> overflow bucket
+
+
+# -- host fold / gauges ------------------------------------------------------
+def _synth_plane(loss=1.0, gnorm=2.0, steps=1.0) -> np.ndarray:
+    p = np.zeros(learning.PLANE_SIZE, np.float32)
+    p[0] = 3.0                                  # 3 underflow TD samples
+    p[learning.I_TD_SUM] = 6.0
+    p[learning.I_PRIO_SUM] = 3.0
+    p[learning.I_ISW_SUM] = 3.0
+    p[learning.I_SAMPLES] = 3.0
+    p[learning.I_LOSS_SUM] = loss * steps
+    p[learning.I_GNORM_SUM] = gnorm * steps
+    p[learning.I_GNORM_CLIP_SUM] = gnorm * steps
+    p[learning.I_QMEAN_SUM] = 0.5 * steps
+    p[learning.I_REFRESH] = steps
+    p[learning.I_STEPS] = steps
+    p[learning.I_TD_MAX] = 4.0
+    p[learning.I_Q_MAX] = 2.0
+    p[learning.I_PRIO_MAX] = 1.0
+    p[learning.I_ISW_MIN] = 0.25
+    p[learning.I_TD_MIN] = 0.5
+    return p
+
+
+def test_fold_plane_stack_equals_sequential_folds():
+    a, b = learning.host_plane(), learning.host_plane()
+    p = _synth_plane()
+    learning.fold_plane(a, p)
+    learning.fold_plane(a, p)
+    learning.fold_plane(b, np.stack([p, p]))
+    np.testing.assert_array_equal(a, b)
+    assert a[learning.I_SAMPLES] == 6.0
+    assert a[learning.I_TD_MAX] == 4.0 and a[learning.I_ISW_MIN] == 0.25
+
+
+def test_accumulator_window_drain_and_republish():
+    acc = learning.LearnAccumulator()
+    assert acc.gauges() == {}                   # nothing folded yet
+
+    acc.ingest(_synth_plane(loss=1.0, steps=1.0))
+    acc.ingest(_synth_plane(loss=1.0, steps=1.0))
+    g = acc.gauges()
+    assert g["learn/loss"] == 1.0               # 2.0 summed / 2 steps
+    assert g["learn/td_mean"] == 2.0            # 12 / 6 samples
+    assert g["learn/td_max"] == 4.0
+    assert g["learn/is_weight_min"] == 0.25
+    assert g["learn/steps"] == 2.0              # cumulative, not window
+    assert acc.planes == 2
+
+    # no new planes: the last gauges are re-published (a stalled
+    # learner holds its readings, not flaps to zero)
+    assert acc.gauges() == g
+
+    # a fresh plane drains a FRESH window — only the new loss shows
+    acc.ingest(_synth_plane(loss=9.0, steps=1.0))
+    g2 = acc.gauges()
+    assert g2["learn/loss"] == 9.0
+    assert g2["learn/steps"] == 3.0
+
+    # the cumulative TD histogram kept every fold
+    h = acc.hist_snapshot()
+    assert h.count == 9 and h.vmax == 4.0
+
+
+# -- metrics-off is bitwise free: fused transition chain ---------------------
+def _fused_build(learn_metrics: bool):
+    from distributed_deep_q_tpu.replay.device_per import DevicePERFrameReplay
+    from distributed_deep_q_tpu.solver import Solver
+
+    cfg = Config()
+    cfg.mesh.backend = "cpu"
+    cfg.mesh.dp = 2
+    cfg.net = NetConfig(kind="nature_cnn", num_actions=4,
+                        frame_shape=(36, 36))
+    cfg.replay = ReplayConfig(capacity=512, batch_size=16, n_step=2,
+                              prioritized=True, device_per=True,
+                              write_chunk=16, fused_chain=3)
+    cfg.train.learn_metrics = learn_metrics
+    solver = Solver(cfg)
+    dev = DevicePERFrameReplay(cfg.replay, solver.mesh, (36, 36), stack=4,
+                               gamma=0.99, seed=0, write_chunk=16)
+    rng = np.random.default_rng(0)
+    for i in range(300):
+        dev.add(rng.integers(0, 255, (36, 36), dtype=np.uint8),
+                int(rng.integers(4)), float(rng.standard_normal()),
+                done=(i % 9 == 8))
+    dev.flush()
+    return solver, dev
+
+
+def test_fused_chain_learn_metrics_off_is_bitwise_identical():
+    """Same seeds, flag off vs on: params, optimizer state, and scattered
+    priorities must be EXACTLY equal — the plane carry may not perturb
+    the training math. The on-build additionally returns one finite
+    per-dispatch plane whose internal counts agree."""
+    sa, da = _fused_build(False)
+    sb, db = _fused_build(True)
+    ma = sa.train_steps_device_per(da, chain=3)
+    mb = sb.train_steps_device_per(db, chain=3)
+    jax.block_until_ready(sa.state.params)
+    jax.block_until_ready(sb.state.params)
+
+    for xa, xb in zip(jax.tree.leaves(sa.state), jax.tree.leaves(sb.state)):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+    np.testing.assert_array_equal(np.asarray(da.dstate.prio),
+                                  np.asarray(db.dstate.prio))
+
+    assert "learn_plane" not in ma
+    p = np.asarray(mb["learn_plane"], np.float64)
+    assert p.shape == (learning.PLANE_SIZE,)
+    assert np.isfinite(p).all()
+    assert p[learning.I_STEPS] == 3.0           # one count per chain step
+    # every histogrammed sample was counted exactly once (psum'd twin)
+    assert p[:learning.N_HIST].sum() == p[learning.I_SAMPLES]
+    assert p[learning.I_TD_MIN] <= p[learning.I_TD_MAX]
+
+
+# -- metrics-off is bitwise free: Anakin superstep ---------------------------
+def _anakin_config(learn_metrics: bool):
+    return Config(
+        env=EnvConfig(id="signal", kind="signal_atari",
+                      frame_shape=(10, 10), stack=2),
+        net=NetConfig(kind="mlp", num_actions=4, hidden=(32, 32),
+                      frame_shape=(10, 10), stack=2),
+        replay=ReplayConfig(capacity=256, batch_size=16, fused_chain=2,
+                            n_step=1, learn_start=0, device_resident=True,
+                            write_chunk=32),
+        train=TrainConfig(optimizer="adam", seed=3, stack_forwards="on",
+                          learn_metrics=learn_metrics),
+        actors=ActorConfig(anakin_envs=16, anakin_ticks=8),
+        mesh=MeshConfig(backend="cpu", num_fake_devices=8),
+    )
+
+
+def test_anakin_learn_metrics_off_is_bitwise_identical():
+    """Two Anakin runners, same config ± the plane: ring contents, θ,
+    θ⁻, and Adam state after ``sync_solver`` must be exactly equal; the
+    on-runner's superstep additionally returns the finalized plane."""
+    from distributed_deep_q_tpu.parallel.anakin import AnakinRunner
+
+    def drive(lm: bool):
+        runner = AnakinRunner(_anakin_config(lm))
+        for _ in range(2):
+            metrics = runner.superstep()
+        runner.sync_solver()
+        return runner, metrics
+
+    ra, ma = drive(False)
+    rb, mb = drive(True)
+
+    # frames compare per REAL row — the per-shard scratch row is the
+    # designated dump for out-of-window ghost lanes, garbage by
+    # contract on both builds and never read back
+    rp = ra.replay
+    shape = (rp.num_shards, rp.shard_rows, rp.rowb // 4)
+    np.testing.assert_array_equal(
+        np.asarray(ra.dstate.frames).reshape(shape)[:, :rp.cap_local_pad],
+        np.asarray(rb.dstate.frames).reshape(shape)[:, :rp.cap_local_pad])
+    for field in ("action", "reward", "done", "boundary", "prio", "maxp"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ra.dstate, field)),
+            np.asarray(getattr(rb.dstate, field)),
+            err_msg=f"ring field {field!r} diverged under learn_metrics")
+    for xa, xb in zip(jax.tree.leaves(ra.solver.state),
+                      jax.tree.leaves(rb.solver.state)):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+    assert "learn_plane" not in ma
+    p = np.asarray(mb["learn_plane"], np.float64)
+    assert np.isfinite(p).all()
+    assert p[learning.I_STEPS] == rb.chain      # one plane per dispatch
+    assert p[:learning.N_HIST].sum() == p[learning.I_SAMPLES]
+
+
+# -- divergence detection ----------------------------------------------------
+def test_loss_divergence_trend_fires_on_spike():
+    """The chaos gate's named finding, in miniature: a flat loss series
+    is ok; a 50× spike walks the learner monitor to degraded with a
+    ``loss_divergence`` finding carrying the spiked value."""
+    health.configure(enabled=True, fast_window_s=1.0, slow_window_s=5.0)
+    try:
+        mon = health.HealthMonitor(health.default_learn_rules(),
+                                   health.default_learn_trends(),
+                                   name="learner")
+        t0 = 100.0
+        for i in range(6):
+            mon.sample({"learn/loss": 1.0, "learn/grad_norm": 2.0},
+                       t=t0 + 0.5 * i)
+        assert mon.verdict(t=t0 + 3.0).status == "ok"
+
+        mon.sample({"learn/loss": 50.0, "learn/grad_norm": 2.0},
+                   t=t0 + 3.5)
+        v = mon.verdict(t=t0 + 3.5)
+        assert v.status == "degraded"
+        hits = [f for f in v.findings if f.rule == "loss_divergence"]
+        assert hits and hits[0].value == 50.0 and hits[0].kind == "trend"
+    finally:
+        health.reset()
+
+
+def test_learn_scrape_feeds_fleet_verdict():
+    """``learn_scrape_fn`` is a well-formed fleet member: the aggregate
+    verdict carries the learner's findings under its member name and
+    survives ``to_jsonable`` with the wire schema intact."""
+    health.configure(enabled=True, fast_window_s=1.0, slow_window_s=5.0)
+    try:
+        acc = learning.LearnAccumulator()
+        mon = health.HealthMonitor(health.default_learn_rules(),
+                                   health.default_learn_trends(),
+                                   name="learner")
+        fleet = health.FleetHealth()
+        fleet.register("learner", learning.learn_scrape_fn(acc, mon))
+
+        t0 = 200.0
+        for i in range(6):
+            acc.ingest(_synth_plane(loss=1.0))
+            fleet.scrape(t=t0 + 0.5 * i)
+        assert fleet.scrape(t=t0 + 3.0).status == "ok"
+
+        acc.ingest(_synth_plane(loss=60.0))
+        v = fleet.scrape(t=t0 + 3.5)
+        assert v.status == "degraded"
+        assert any(f.rule == "loss_divergence" and f.member == "learner"
+                   for f in v.findings)
+        wire = v.to_jsonable()
+        assert wire["status"] == "degraded" and not wire["ok"]
+        assert all({"rule", "severity", "kind"} <= set(f)
+                   for f in wire["findings"])
+    finally:
+        health.reset()

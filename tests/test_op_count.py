@@ -41,6 +41,11 @@ META_PACK_BUDGET = (4, 0, 2)        # measured 2/0/0 (ISSUE 8)
 # tiny mlp shape; copies are inflated by interpret-mode Pallas on the CPU
 # test backend (the row-DMA kernels lower to real DMA on TPU)
 ANAKIN_SUPERSTEP_BUDGET = (205, 0, 220)  # measured 189/0/202 (ISSUE 11)
+# same superstep with the learning-dynamics plane carried (ISSUE 16):
+# the plane costs +7 fusions / +3 copies on this shape (196/0/205) — the
+# documented price of cfg.train.learn_metrics; off stays bitwise at the
+# budget above (pinned by test_learning_metrics.py)
+ANAKIN_SUPERSTEP_LM_BUDGET = (215, 0, 225)  # measured 196/0/205
 
 
 def _assert_within(census, budget, label):
@@ -201,6 +206,59 @@ def test_anakin_superstep_budget(anakin_superstep_hlo):
 
     _assert_within(hlo_op_census(anakin_superstep_hlo),
                    ANAKIN_SUPERSTEP_BUDGET, "anakin superstep")
+
+
+@pytest.fixture(scope="module")
+def anakin_superstep_lm_hlo():
+    """Same superstep, ``cfg.train.learn_metrics`` on: the plane rides
+    the train-scan carry and is finalized with the chunk's collectives,
+    so it must change neither the zero-host-comm contract nor the op
+    census by more than its documented delta."""
+    from distributed_deep_q_tpu.parallel.anakin import AnakinRunner
+
+    cfg = Config(
+        env=EnvConfig(id="signal", kind="signal_atari",
+                      frame_shape=(10, 10), stack=2),
+        net=NetConfig(kind="mlp", num_actions=4, hidden=(32, 32),
+                      frame_shape=(10, 10), stack=2),
+        replay=ReplayConfig(capacity=256, batch_size=16, fused_chain=2,
+                            n_step=1, learn_start=0, device_resident=True,
+                            write_chunk=32),
+        train=TrainConfig(optimizer="adam", seed=3, stack_forwards="on",
+                          learn_metrics=True),
+        actors=ActorConfig(anakin_envs=16, anakin_ticks=8),
+        mesh=MeshConfig(backend="cpu", num_fake_devices=8),
+    )
+    runner = AnakinRunner(cfg)
+    keys = runner.solver._next_sample_keys(runner.num_shards, runner.chain)
+    betas = np.asarray(runner.replay.next_betas(runner.chain), np.float32)
+    return runner._fn.lower(runner._carry, runner._eps, keys,
+                            betas).compile().as_text()
+
+
+def test_anakin_superstep_lm_zero_host_transfers(anakin_superstep_lm_hlo):
+    """ISSUE 16 acceptance pin: the metrics plane is accumulated with
+    plain jnp in the scan body and leaves as an ordinary program output
+    — enabling it must add ZERO infeed/outfeed/send/recv ops."""
+    from distributed_deep_q_tpu.profiling import hlo_op_census
+
+    census = hlo_op_census(
+        anakin_superstep_lm_hlo,
+        ops=("infeed", "outfeed", "send", "recv", "copy-start"))
+    hot = {k: v for k, v in census.items()
+           if k != "scheduled_total" and v != 0}
+    assert not hot, (
+        f"learn_metrics superstep schedules host-communication ops {hot} "
+        "— the plane must stay a plain program output")
+
+
+def test_anakin_superstep_lm_budget(anakin_superstep_lm_hlo):
+    """The plane's op price is ratcheted separately so creep in the
+    metrics math is caught without loosening the metrics-off budget."""
+    from distributed_deep_q_tpu.profiling import hlo_op_census
+
+    _assert_within(hlo_op_census(anakin_superstep_lm_hlo),
+                   ANAKIN_SUPERSTEP_LM_BUDGET, "anakin superstep (lm)")
 
 
 @pytest.fixture(scope="module")
