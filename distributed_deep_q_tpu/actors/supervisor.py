@@ -1064,6 +1064,14 @@ def _bring_up_rpc_plane(cfg: Config, replay, obs_dim: int = 4):
                 shed_policy=cfg.replay.shed_policy))
         cfg.inference.host, cfg.inference.port = infer_server.address
     host, port = server.address
+    # elastic-fleet registry (ISSUE 17): the learner host seeds the
+    # membership plane with itself, so fleet_* verbs answer on this
+    # wire from the first actor connection on — joiners and leavers
+    # mutate the epoch at runtime, no reboot
+    from distributed_deep_q_tpu.actors.membership import MembershipRegistry
+    registry = MembershipRegistry()
+    registry.join(f"host-{cfg.mesh.process_id}", host, port)
+    server.attach_membership(registry)
     sup = ActorSupervisor(cfg, host, port)
     sup.start()
     sup.watch(server.last_seen)
@@ -1109,12 +1117,34 @@ def _bring_up_health_plane(cfg: Config, server, infer_server=None,
     return fleet, MFUMeter(flops, peak)
 
 
+def _bring_up_autoscaler(cfg: Config):
+    """Health-driven autoscaler (ISSUE 17) — ``None`` unless BOTH the
+    health plane and ``cfg.autoscale`` are enabled; its only input is
+    the fleet verdict, so without scrapes it could only ever no-op."""
+    if not (health.ENABLED and cfg.autoscale.enabled):
+        return None
+    from distributed_deep_q_tpu.actors.autoscaler import Autoscaler
+    a = cfg.autoscale
+    boot = cfg.actors.fleet_size or cfg.actors.num_actors
+    return Autoscaler(
+        min_actors=min(a.min_actors, boot),
+        max_actors=a.max_actors or boot,
+        min_inference=a.min_inference, max_inference=a.max_inference,
+        step=a.step, cooldown_s=a.cooldown_s,
+        recover_ticks=a.recover_ticks)
+
+
 def _health_tick(fleet, meter, server, gstep: int,
-                 scrape: bool = True) -> dict:
+                 scrape: bool = True, autoscaler=None) -> dict:
     """Per-log-tick health/efficiency record: live MFU + ingest
     utilization gauges, fleet self-accounting, and the aggregated
     verdict (a JSON-able dict — ``Metrics.log`` passes non-numerics
-    through to the run JSONL untouched). Empty while disabled."""
+    through to the run JSONL untouched). Empty while disabled.
+
+    With an autoscaler attached, each FRESH scrape is folded through it
+    (stale ``last()`` verdicts would double-count into the recovery
+    streak) and any decisions ride the same record under
+    ``autoscale/decision`` — rule + burn numbers, lineage-traceable."""
     if not health.ENABLED:
         return {}
     fc = server.flow_counters()
@@ -1122,6 +1152,14 @@ def _health_tick(fleet, meter, server, gstep: int,
                        consume_rate=fc["consume_rate"])
     v = fleet.scrape() if scrape else fleet.last()
     out.update(fleet.gauges())
+    if server.membership is not None:
+        out.update(server.membership.gauges())
+    if autoscaler is not None and scrape:
+        decisions = autoscaler.observe(v)
+        out.update(autoscaler.gauges())
+        if decisions:
+            out["autoscale/decision"] = [d.to_jsonable()
+                                         for d in decisions]
     out["health/verdict"] = v.to_jsonable()
     return out
 
@@ -1236,6 +1274,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     fleet_health, mfu_meter = _bring_up_health_plane(
         cfg, server, infer_server, solver=solver, replay=replay,
         fused=fused_per)
+    autoscaler = _bring_up_autoscaler(cfg)
     writeback = None
     if replay.prioritized and not fused_per:
         from distributed_deep_q_tpu.replay.prioritized import make_writeback
@@ -1408,7 +1447,8 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                 hk = _health_tick(
                     fleet_health, mfu_meter, server, gstep,
                     scrape=(gstep // log_every)
-                    % max(cfg.health.scrape_every, 1) == 0)
+                    % max(cfg.health.scrape_every, 1) == 0,
+                    autoscaler=autoscaler)
                 metrics.log(gstep, **summary, **timer.summary(),
                             **server.telemetry_summary(), **infer_tm,
                             **metrics.telemetry(), **hk)
@@ -1539,6 +1579,7 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
     # recurrent state — the transition-path census doesn't apply), so
     # live MFU is absent here; steps/s + ingest utilization still emit
     fleet_health, mfu_meter = _bring_up_health_plane(cfg, server)
+    autoscaler = _bring_up_autoscaler(cfg)
     writeback = None
     if replay.prioritized and not fused_seq:
         from distributed_deep_q_tpu.replay.prioritized import make_writeback
@@ -1624,7 +1665,8 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
                 hk = _health_tick(
                     fleet_health, mfu_meter, server, gstep,
                     scrape=(gstep // log_every)
-                    % max(cfg.health.scrape_every, 1) == 0)
+                    % max(cfg.health.scrape_every, 1) == 0,
+                    autoscaler=autoscaler)
                 metrics.log(gstep, **summary, **timer.summary(),
                             **server.telemetry_summary(),
                             **metrics.telemetry(), **hk)

@@ -194,6 +194,22 @@ DEFAULT_REGISTRY = LockRegistry(
         "_lm_window":       Guard("_lm_lock", "LearnAccumulator"),
         "_lm_planes":       Guard("_lm_lock", "LearnAccumulator"),
         "_lm_last":         Guard("_lm_lock", "LearnAccumulator"),
+        # MembershipRegistry (ISSUE 17): the epoch-numbered host set,
+        # shard lineage, and churn counters move together — serve
+        # threads answering fleet_* verbs race the supervisor's gauge
+        # reads and the lease sweeper
+        "_fleet_members":   Guard("_fleet_lock", "MembershipRegistry"),
+        "_fleet_epoch":     Guard("_fleet_lock", "MembershipRegistry"),
+        "_fleet_lineage":   Guard("_fleet_lock", "MembershipRegistry"),
+        "_fleet_stats":     Guard("_fleet_lock", "MembershipRegistry"),
+        # Autoscaler (ISSUE 17): targets, streak, cooldown stamps, and
+        # decision counters under one RLock (helpers re-acquire
+        # lexically, HealthMonitor precedent)
+        "_as_target_actors": Guard("_as_lock", "Autoscaler"),
+        "_as_target_inference": Guard("_as_lock", "Autoscaler"),
+        "_as_ok_streak":    Guard("_as_lock", "Autoscaler"),
+        "_as_last_at":      Guard("_as_lock", "Autoscaler"),
+        "_as_counts":       Guard("_as_lock", "Autoscaler"),
         # NOTE deliberately unregistered: ReplayFeedServer.last_seen is a
         # GIL-atomic monotonic stamp dict (single-writer per key, reader
         # tolerates staleness); DeviceStager._err is benign once-set.
@@ -206,6 +222,8 @@ DEFAULT_REGISTRY = LockRegistry(
         "distributed_deep_q_tpu/rpc/replay_server.py",
         "distributed_deep_q_tpu/rpc/inference_server.py",
         "distributed_deep_q_tpu/actors/supervisor.py",
+        "distributed_deep_q_tpu/actors/membership.py",
+        "distributed_deep_q_tpu/actors/autoscaler.py",
         "distributed_deep_q_tpu/health.py",
         "distributed_deep_q_tpu/learning.py",
         "distributed_deep_q_tpu/replay/staging.py",

@@ -42,7 +42,7 @@ RULE_SPAN = "metric_keys.unknown-span"
 
 NAMESPACES = ("rpc", "fleet", "queue", "durability", "flow", "trace",
               "learner", "ingest", "inference", "shard", "actor",
-              "health", "train", "learn")
+              "health", "train", "learn", "autoscale")
 _NS_RE = re.compile(r"^(?:%s)/.+" % "|".join(NAMESPACES))
 
 EMITTERS = frozenset(
@@ -165,6 +165,27 @@ REGISTRY = frozenset({
     "learn/loss_nonfinite",
     "learn/steps",
     "learn/td_error",
+    # elastic-fleet plane (ISSUE 17): membership-registry gauges, the
+    # shard-handoff receipt the churn gate + strict report consume, the
+    # remap-storm reconnect counter, and the autoscaler's decision
+    # record (a JSON list in the run JSONL) + its self-accounting
+    "fleet/epoch",
+    "fleet/members",
+    "fleet/joins",
+    "fleet/leaves",
+    "fleet/lease_expired",
+    "fleet/handoffs",
+    "fleet/handoff_ms",
+    "fleet/handoff_rows",
+    "fleet/handoff_lost_rows",
+    "rpc/mass_reconnects",
+    "autoscale/decision",
+    "autoscale/decisions",
+    "autoscale/grow",
+    "autoscale/shrink",
+    "autoscale/cooldown_blocked",
+    "autoscale/target_actors",
+    "autoscale/target_inference",
 })
 
 _TRACING_REL = os.path.join("distributed_deep_q_tpu", "tracing.py")

@@ -34,6 +34,10 @@ from distributed_deep_q_tpu.analysis.core import (
 SERVER_TABLES = (
     ("distributed_deep_q_tpu/rpc/replay_server.py", "ReplayFeedServer"),
     ("distributed_deep_q_tpu/rpc/inference_server.py", "InferenceServer"),
+    # elastic-fleet verbs (ISSUE 17): ReplayFeedServer delegates
+    # fleet_* to the attached registry, whose own _dispatch holds the
+    # authoritative method branches
+    ("distributed_deep_q_tpu/actors/membership.py", "MembershipRegistry"),
 )
 PROTOCOL_FILE = "distributed_deep_q_tpu/rpc/protocol.py"
 EMITTER_DIRS = ("distributed_deep_q_tpu", "scripts", "tests")

@@ -371,6 +371,35 @@ class HealthConfig:
 
 
 @dataclass
+class AutoscaleConfig:
+    """Health-driven autoscaler (``actors/autoscaler.py``).
+
+    Off by default (and inert unless the health plane is on — its only
+    input is the fleet ``HealthVerdict``). When enabled, the supervisor
+    folds each scraped verdict through the autoscaler on the health
+    tick; decisions land in the run JSONL under ``autoscale/decision``
+    with the triggering rule and burn numbers, and the targets are
+    exported as ``autoscale/target_*`` gauges. The scaler only decides;
+    acting on a decision is the operator's (or the churn harness's)
+    job.
+    """
+
+    enabled: bool = False
+    # actor-capacity band; max_actors=0 = the boot fleet size
+    min_actors: int = 1
+    max_actors: int = 0
+    # inference-capacity band (replicas of the batched-inference plane)
+    min_inference: int = 0
+    max_inference: int = 0
+    # capacity change per decision
+    step: int = 1
+    # per-dimension cooldown between decisions (anti-flap damper)
+    cooldown_s: float = 30.0
+    # consecutive ok verdicts required before growing back (hysteresis)
+    recover_ticks: int = 3
+
+
+@dataclass
 class InferenceConfig:
     """Batched inference plane (``rpc/inference_server.py``).
 
@@ -418,6 +447,7 @@ class Config:
     trace: TraceConfig = field(default_factory=TraceConfig)
     inference: InferenceConfig = field(default_factory=InferenceConfig)
     health: HealthConfig = field(default_factory=HealthConfig)
+    autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
 
     def replace(self, **kv: Any) -> "Config":
         return dataclasses.replace(self, **kv)

@@ -508,6 +508,14 @@ class FleetHealth:
         with self._lock:
             self._members[name] = scrape_fn
 
+    def deregister(self, name: str) -> bool:
+        """Drop a member from the scrape set — the elastic-fleet leave
+        path (actors/membership.py): a host that handed its shard off
+        and left ON PURPOSE must stop burning the unreachable budget.
+        Returns False if the name was never registered."""
+        with self._lock:
+            return self._members.pop(name, None) is not None
+
     def scrape(self, t: float | None = None) -> HealthVerdict:
         if not ENABLED:
             return NULL_VERDICT

@@ -44,6 +44,13 @@ from distributed_deep_q_tpu.utils.durability import (
 
 log = logging.getLogger(__name__)
 
+# elastic-fleet verbs delegated to an attached MembershipRegistry
+# (actors/membership.py keeps the authoritative FLEET_METHODS tuple;
+# spelled out here so the wire layer stays import-light — membership is
+# only imported by whoever attaches a registry)
+_FLEET_METHODS = ("fleet_join", "fleet_leave", "fleet_lease",
+                  "fleet_view")
+
 
 class ServerTelemetry:
     """Server-side RPC + fleet accounting (observability spine).
@@ -326,6 +333,10 @@ class ReplayFeedServer:
         self._lineage: dict[int, tuple[float, int]] = {}
         self._err_log_at = 0.0
         self._err_suppressed = 0
+        # elastic-fleet plane (membership.py): the seed host attaches a
+        # MembershipRegistry so fleet_* verbs answer on this wire. Set
+        # once before actors connect, read-only afterwards — no lock
+        self.membership = None
         # live accepted connections, closed on shutdown so reconnecting
         # actors fail fast into their retry policy instead of blocking on
         # a half-dead socket
@@ -360,6 +371,12 @@ class ReplayFeedServer:
         self._accept_thread.start()
 
     # -- learner-side API ---------------------------------------------------
+
+    def attach_membership(self, registry) -> None:
+        """Install the fleet registry (actors/membership.py) so this
+        server answers the ``fleet_*`` verbs. Called once at bring-up,
+        before any actor connects."""
+        self.membership = registry
 
     def publish_params(self, weights: list[np.ndarray]) -> int:
         """Install a new θ snapshot for actors to pull; returns version.
@@ -772,6 +789,26 @@ class ReplayFeedServer:
 
         if method == "heartbeat":
             return {"ok": True}
+
+        if method == "stream_seq":
+            # elastic remap support (actors/membership.py): the highest
+            # flush_seq this shard has LANDED for the asking actor. A
+            # remapped actor queries its old shard's importer before
+            # releasing an in-flight resend — a floor at or above the
+            # in-flight seq means the flush traveled inside the handoff
+            # snapshot and must not be re-sent elsewhere
+            with self.replay_lock:
+                return {"ok": True,
+                        "seq": self._flush_seq.get(actor_id, -1)}
+
+        if method in _FLEET_METHODS:
+            # elastic-fleet verbs delegate to the attached registry —
+            # its own _dispatch owns the method branches (and the
+            # protocol-drift pass reads them from there)
+            registry = self.membership
+            if registry is None:
+                return {"error": "no membership registry on this host"}
+            return registry._dispatch(req)
 
         if method == "health":
             # one scrape = sample current telemetry into the windowed

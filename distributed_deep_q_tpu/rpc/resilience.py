@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import logging
 import socket
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -60,6 +61,25 @@ class RPCError(RuntimeError):
     """The server answered with an application error — retrying won't help."""
 
 
+# process-wide mass-reconnect accounting (rpc/mass_reconnects): every
+# remap-flavored ``rehost`` from any client in this process counts here,
+# so the churn harness and the supervisor read one fleet-level gauge
+_herd_lock = threading.Lock()
+_mass_reconnects = 0
+
+
+def mass_reconnects() -> int:
+    """Total remap-driven reconnects across every client in-process."""
+    with _herd_lock:
+        return _mass_reconnects
+
+
+def _note_mass_reconnect() -> None:
+    global _mass_reconnects
+    with _herd_lock:
+        _mass_reconnects += 1
+
+
 @dataclass(frozen=True)
 class RetryPolicy:
     """Exponential backoff + jitter with a total wall-clock deadline."""
@@ -79,20 +99,40 @@ class RetryPolicy:
             raw *= 1.0 - self.jitter * float(rng.random())
         return raw
 
+    def backoff_decorrelated(self, prev: float,
+                             rng: np.random.Generator) -> float:
+        """Decorrelated-jitter sleep: ``U[base, 3·prev]`` capped at
+        ``max_delay``. Unlike the exponential ladder, consecutive
+        delays share no deterministic schedule — when a whole actor
+        slice remaps at once (a fleet-epoch change), the herd's retries
+        spread across the full window instead of arriving in the
+        lock-stepped waves the ladder produces."""
+        prev = max(float(prev), self.base_delay)
+        return min(self.max_delay,
+                   self.base_delay
+                   + (3.0 * prev - self.base_delay) * float(rng.random()))
+
     def run(self, fn: Callable[[], Any], *, rng: np.random.Generator,
             should_abort: Callable[[], bool] | None = None,
-            on_retry: Callable[[int, BaseException], None] | None = None):
+            on_retry: Callable[[int, BaseException], None] | None = None,
+            decorrelate: bool = False):
         """Call ``fn`` until success, non-retryable error, abort, or
-        deadline; re-raises the last retryable error on give-up."""
+        deadline; re-raises the last retryable error on give-up.
+        ``decorrelate=True`` swaps the exponential ladder for the
+        decorrelated-jitter schedule (mass-remap reconnects)."""
         start = time.monotonic()
         attempt = 0
+        prev = self.base_delay
         while True:
             try:
                 return fn()
             except self.retryable as e:
                 if should_abort is not None and should_abort():
                     raise
-                delay = self.backoff(attempt, rng)
+                if decorrelate:
+                    delay = prev = self.backoff_decorrelated(prev, rng)
+                else:
+                    delay = self.backoff(attempt, rng)
                 if time.monotonic() + delay - start > self.deadline:
                     raise
                 if on_retry is not None:
@@ -130,6 +170,18 @@ class ResilientReplayFeedClient:
         self.sheds = 0          # flushes answered with SHED, then re-sent
         self.throttled_s = 0.0  # total seconds spent pacing to credits
         self.params_version = -1
+        # elastic-fleet remap state (actors/membership.py): after a
+        # fleet-epoch remap the old shard's importer is queried for this
+        # actor's highest LANDED flush_seq; any in-flight resend at or
+        # below the floor already traveled inside the handoff snapshot
+        # and is answered synthetically instead of double-sent
+        self.resend_floor = -1
+        self.resends_skipped = 0
+        self.mass_reconnects = 0   # remap-flavored rehosts on this client
+        # one-outage flag: a remap reconnect uses decorrelated jitter so
+        # the whole remapped slice doesn't retry in lock-stepped waves;
+        # the first success reverts to the plain ladder
+        self._decorrelate = False
         # optional liveness hook, called while waiting out backpressure —
         # the supervisor wires this to its progress watermark so a long
         # throttle reads as intentional waiting, not a hang
@@ -168,9 +220,12 @@ class ResilientReplayFeedClient:
 
     def _run(self, method: str, fn: Callable[[], Any]):
         try:
-            return self.policy.run(fn, rng=self._rng,
-                                   should_abort=self._should_abort,
-                                   on_retry=self._on_retry(method))
+            out = self.policy.run(fn, rng=self._rng,
+                                  should_abort=self._should_abort,
+                                  on_retry=self._on_retry(method),
+                                  decorrelate=self._decorrelate)
+            self._decorrelate = False  # outage over; back to the ladder
+            return out
         except self.policy.retryable:
             self.gave_up += 1
             raise
@@ -212,12 +267,21 @@ class ResilientReplayFeedClient:
                 # exact pre-ISSUE-7 payload
                 ctx = tracing.wire_context()
                 t1 = tracing.now() if tracing.ENABLED else 0.0
+
+                def _send(seq=seq, ctx=ctx):
+                    # re-checked on EVERY retry attempt: the remap
+                    # watcher may raise the floor while this flush is
+                    # mid-backoff against its departed owner
+                    if seq <= self.resend_floor:
+                        self.resends_skipped += 1
+                        return {"ok": True, "duplicate": True,
+                                "resend_skipped": True}
+                    return self._client.call("add_transitions",
+                                             flush_seq=seq, **ctx,
+                                             **batch)
+
                 with tracing.span("rpc_call"):
-                    resp = self._run(
-                        "add_transitions",
-                        lambda: self._client.call("add_transitions",
-                                                  flush_seq=seq, **ctx,
-                                                  **batch))
+                    resp = self._run("add_transitions", _send)
                 if resp.get("error"):
                     # the server rejected the payload (malformed batch,
                     # not a transport fault) — surface it loudly;
@@ -272,12 +336,22 @@ class ResilientReplayFeedClient:
                 return
             time.sleep(min(remaining, 0.2))
 
-    def rehost(self, host: str, port: int) -> None:
+    def rehost(self, host: str, port: int, remap: bool = False) -> None:
         """Repoint at a moved server (same hash-assigned host, new
         address — ISSUE 10's reconnect seam). The next call reconnects
         through the normal retry path; in-flight idempotency state
         (``flush_seq``, credits) carries over because the HOST — and
-        hence the server-side dedup/ledger identity — is unchanged."""
+        hence the server-side dedup/ledger identity — is unchanged.
+
+        ``remap=True`` marks a fleet-epoch remap (this actor's OWNER
+        changed, not just its address): the reconnect counts into the
+        ``rpc/mass_reconnects`` gauge and the next outage's retries use
+        decorrelated jitter, so a whole remapped slice spreads its
+        reconnects instead of thundering in ladder lock-step."""
+        if remap:
+            self.mass_reconnects += 1
+            _note_mass_reconnect()
+            self._decorrelate = True
         self._client.rehost(host, port)
 
     def get_params(self, have_version: int = -1):

@@ -51,6 +51,13 @@ SPREAD_KEY = {
     # as learn_spread (learn_off/on_steps_per_s follow the automatic
     # "<prefix>_spread" convention and need no entry here)
     "learn_overhead_pct": "learn_spread",
+    # elasticity rows (ISSUE 17) share one measured handoff spread; the
+    # remap fractions are ring properties (deterministic given the host
+    # set) but ride the same key so a ring change gates like noise would
+    "handoff_export_ms": "elasticity_spread",
+    "handoff_import_ms": "elasticity_spread",
+    "remap_fraction_grow": "elasticity_spread",
+    "remap_fraction_shrink": "elasticity_spread",
 }
 
 # substrings marking metrics where UP is the bad direction
@@ -58,7 +65,10 @@ SPREAD_KEY = {
 # replay traffic is a sharding violation, so up must gate, and the
 # common old=0 case makes any appearance an infinite regression)
 _LOWER_BETTER = ("_ms", "_fusions", "_convs", "_copies", "fusions",
-                 "spread", "_rpcs", "_us", "overhead_pct")
+                 "spread", "_rpcs", "_us", "overhead_pct",
+                 # remap fraction: more of the fleet reconnecting per
+                 # membership change is strictly worse (reconnect storm)
+                 "remap_fraction")
 # keys that are configuration echoes / identities, not metrics
 # (max_in_flight_rows is the writers' backpressure watermark — a state
 # echo of the pacing loop, not a quality axis with a bad direction;
@@ -72,6 +82,9 @@ _SKIP = ("_chain_k", "_vs_", "vs_baseline", "ring_capacity",
          "flops_per_step", "max_in_flight_rows", "inference_slo_ms",
          "inference_max_batch", "inference_cutoff_us", "sheds",
          "local_actions_per_s", "n_hosts", "dispatch_k", "n_envs",
+         # elasticity bench identities: rows carried per handoff and the
+         # acting fleet the remap fractions are computed over
+         "handoff_rows", "fleet_size",
          # config echo: the live-vs-offline MFU agreement bound bench.py
          # asserts; the gated quality axes are mfu / mfu_live themselves
          "mfu_live_tolerance")

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Elasticity bench (ISSUE 17): shard-handoff wall time + remap churn.
+
+Two measurements behind the PERF.md §17 row:
+
+- **Handoff wall time** — a live ``ReplayFeedServer`` holding a labeled
+  replay shard is gracefully retired through
+  ``membership.export_shard`` (drain → GenerationStore snapshot,
+  manifest-committed) and a fresh server warm-boots it through
+  ``membership.import_shard``. Export and import are timed separately
+  over ``--repeats`` rounds; the row carries the medians and the
+  max relative spread (the bench_diff tolerance).
+- **Remap fraction** — the share of the acting fleet whose owner
+  changes across 2→4 (grow) and 4→2 (shrink) host-set steps of
+  ``assign_fleet``. Deterministic given the ring, so a drift here is a
+  ring-layout change, not noise: both directions should stay well under
+  the naive-modulo ~0.75 reshuffle.
+
+Output is one flat JSON dict on stdout (bench_diff-ready)::
+
+    python scripts/bench_elasticity.py [--rows 4096] [--repeats 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from distributed_deep_q_tpu.actors import membership as ms  # noqa: E402
+from distributed_deep_q_tpu.actors.assignment import assign_fleet, host_tokens
+from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
+from distributed_deep_q_tpu.rpc.replay_server import (
+    ReplayFeedClient, ReplayFeedServer)
+
+
+def _fill(server: ReplayFeedServer, rows: int) -> None:
+    """Feed ``rows`` labeled transitions through the real wire path so
+    the exported shard is what production would hand off."""
+    host, port = server.address
+    client = ReplayFeedClient(host, port, actor_id=1)
+    try:
+        chunk = 512
+        seq = 0
+        for start in range(0, rows, chunk):
+            n = min(chunk, rows - start)
+            ids = np.arange(start, start + n, dtype=np.float32)
+            obs = np.stack([ids, ids], axis=1)
+            seq += 1
+            client.call("add_transitions", flush_seq=seq, obs=obs,
+                        action=np.zeros(n, np.int32),
+                        reward=np.zeros(n, np.float32), next_obs=obs,
+                        discount=np.ones(n, np.float32))
+    finally:
+        client.close()
+
+
+def bench_handoff(rows: int, repeats: int, tmp: str) -> dict:
+    exports, imports = [], []
+    # round 0 is a discarded warmup: it pays the lazy persistence-module
+    # imports and filesystem cache faults that production hosts paid at
+    # boot, which would otherwise dominate the recorded spread
+    for r in range(repeats + 1):
+        replay = ReplayMemory(max(rows, 1), (2,))
+        server = ReplayFeedServer(replay)
+        _fill(server, rows)
+        path = f"{tmp}/handoff-{r}"
+        export = ms.export_shard(server, path)
+        replay2 = ReplayMemory(max(rows, 1), (2,))
+        server2, imported = ms.import_shard(replay2, path)
+        server2.close()
+        if imported["rows"] != rows or export["rows"] != rows:
+            raise SystemExit(
+                f"handoff lost rows: exported {export['rows']}, "
+                f"imported {imported['rows']}, expected {rows}")
+        if r > 0:
+            exports.append(export["export_ms"])
+            imports.append(imported["import_ms"])
+
+    def spread(xs: list[float]) -> float:
+        m = statistics.median(xs)
+        return (max(xs) - min(xs)) / m if m else 0.0
+
+    return {
+        "handoff_export_ms": round(statistics.median(exports), 3),
+        "handoff_import_ms": round(statistics.median(imports), 3),
+        "handoff_rows": rows,
+        "elasticity_spread": round(max(spread(exports), spread(imports)), 4),
+    }
+
+
+def bench_remap(fleet: int) -> dict:
+    """Owner-change fraction across 2→4 (grow) and 4→2 (shrink)."""
+
+    def owners(hosts):
+        return {g: h for h, v in assign_fleet(fleet, hosts).items()
+                for g in v}
+
+    o2, o4 = owners(host_tokens(2)), owners(host_tokens(4))
+    moved_grow = sum(o2[g] != o4[g] for g in range(fleet))
+    moved_shrink = sum(o4[g] != o2[g] for g in range(fleet))
+    return {
+        "fleet_size": fleet,
+        "remap_fraction_grow": round(moved_grow / fleet, 4),
+        "remap_fraction_shrink": round(moved_shrink / fleet, 4),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=4096)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--fleet", type=int, default=64)
+    args = ap.parse_args(argv)
+    import tempfile
+    with tempfile.TemporaryDirectory(prefix="bench-elasticity-") as tmp:
+        out = bench_handoff(args.rows, args.repeats, tmp)
+    out.update(bench_remap(args.fleet))
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,6 @@
 """Chaos smoke — prove the RPC fault-tolerance stack end to end.
 
-Nine modes:
+Ten modes:
 
 ``python scripts/chaos_smoke.py [num_actors] [spec]`` (default)
     Threaded actor fleet over the production wire protocol: resilient
@@ -92,6 +92,19 @@ Nine modes:
     clean (checked against an independent pre-boot probe), and after
     actors replay their full labeled history through the flush-seq dedup
     there are zero lost, zero duplicated, and zero corrupt rows.
+
+``python scripts/chaos_smoke.py churn``
+    Elastic-fleet acceptance (ISSUE 17): two learner hosts serve a
+    hash-assigned actor fleet through the membership registry; mid-run
+    one host gracefully retires (replay shard exported through the
+    GenerationStore handoff) and a fresh host imports the shard and
+    joins. The gate: the fleet verdict walks ok → degraded
+    (``member_unreachable`` named) → ok with zero critical flaps, the
+    autoscaler's shrink/grow decisions land lineage-traceable in the
+    run JSONL, remapped actors reconnect (``rpc/mass_reconnects``
+    moves) with in-flight flushes exactly-once across the handoff, and
+    the labeled-frame ledger over the union of surviving shards shows
+    zero lost, zero duplicated transitions and zero wrong actions.
 
 ``python scripts/chaos_smoke.py train [cfg.overrides ...]``
     The full distributed trainer (spawned actor processes, mesh learner)
@@ -1339,6 +1352,298 @@ def run_train_chaos(argv: list[str]) -> dict:
     }
 
 
+def run_churn_smoke(num_actors: int = 6, flushes: int = 150, rows: int = 8,
+                    deadline: float = 90.0) -> dict:
+    """Elastic-fleet acceptance (ISSUE 17): kill a learner host mid-run,
+    add a fresh one, lose nothing.
+
+    Two learner hosts serve a hash-assigned actor fleet; the membership
+    registry rides host-0's wire. Mid-run host-1 is gracefully retired —
+    its replay shard exports through the GenerationStore handoff — and a
+    fresh host-2 imports the shard and joins. The fleet verdict must
+    walk ok → degraded (``member_unreachable`` named) → ok with zero
+    critical flaps; the health-driven autoscaler must emit
+    lineage-traceable decisions into the run JSONL (shrink on the lost
+    member, grow on recovery); remapped actors must reconnect through
+    the resilient client (``rpc/mass_reconnects`` moves) with their
+    in-flight flushes staying exactly-once across the handoff. The
+    ledger gate: every labeled transition lands exactly once across the
+    union of surviving shards, with zero wrong actions."""
+    from distributed_deep_q_tpu import health
+    from distributed_deep_q_tpu.actors import membership as ms
+    from distributed_deep_q_tpu.actors.assignment import assign_fleet
+    from distributed_deep_q_tpu.actors.autoscaler import (
+        RECOVERY_RULE, Autoscaler)
+    from distributed_deep_q_tpu.metrics import Metrics
+    from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
+    from distributed_deep_q_tpu.rpc import resilience
+    from distributed_deep_q_tpu.rpc.replay_server import (
+        ReplayFeedClient, ReplayFeedServer)
+    from distributed_deep_q_tpu.rpc.resilience import (
+        ResilientReplayFeedClient, RetryPolicy)
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts"))
+    from telemetry_report import (
+        elastic_problems, load_records, slo_problems)
+
+    health.configure(enabled=True, fast_window_s=0.5, slow_window_s=1.5,
+                     clear_ratio=0.5)
+    jsonl = tempfile.mktemp(prefix="churn_smoke_", suffix=".jsonl")
+    metrics = Metrics(jsonl_path=jsonl)
+    total = num_actors * flushes * rows
+    cap = max(2 * total, 1024)
+    mass_base = resilience.mass_reconnects()
+
+    # two learner hosts; host-0 carries the membership registry
+    registry = ms.MembershipRegistry()
+    replay0 = ReplayMemory(cap, (2,), np.float32, seed=0)
+    server0 = ReplayFeedServer(replay0)
+    server0.attach_membership(registry)
+    registry.join("host-0", *server0.address)
+    replay1 = ReplayMemory(cap, (2,), np.float32, seed=1)
+    server1 = ReplayFeedServer(replay1)
+
+    admin = ReplayFeedClient(*server0.address, actor_id=990, timeout=10.0)
+    admin.call("fleet_join", token="host-1", host=server1.address[0],
+               port=server1.address[1])
+    admin.call("fleet_lease", token="host-0")  # seed host renews too
+    view = admin.call("fleet_view")
+    tokens = ms.view_tokens(view)
+    assignment = assign_fleet(num_actors, tokens)
+    owner0 = {g: t for t, gids in assignment.items() for g in gids}
+
+    # fleet health scrapes both hosts over fresh wire connections (a
+    # dead host must read as member_unreachable, not a cached verdict)
+    fleet = health.FleetHealth()
+
+    def scrape_at(addr):
+        def scrape() -> dict:
+            c = ReplayFeedClient(addr[0], addr[1], actor_id=991,
+                                 timeout=5.0)
+            try:
+                return c.health()
+            finally:
+                c.close()
+        return scrape
+
+    fleet.register("host-0", scrape_at(server0.address))
+    fleet.register("host-1", scrape_at(server1.address))
+
+    autoscaler = Autoscaler(min_actors=2, max_actors=num_actors, step=2,
+                            cooldown_s=0.5, recover_ticks=3)
+
+    policy = RetryPolicy(base_delay=0.01, max_delay=0.3,
+                         deadline=deadline)
+    errors: list[str] = []
+    clients: list = [None] * num_actors
+    act_mod = 7  # expected action for (gid, f) is (gid*31 + f) % 7
+
+    def actor(gid: int) -> None:
+        try:
+            addr = ms.view_address(view, owner0[gid])
+            c = ResilientReplayFeedClient.connect(
+                addr[0], addr[1], actor_id=gid, policy=policy,
+                seed=200 + gid)
+            clients[gid] = c
+            for f in range(flushes):
+                ids = gid * 1_000_000 + f * 1_000 + np.arange(
+                    rows, dtype=np.float32)
+                obs = np.stack([ids, ids], axis=1)
+                c.add_transitions(
+                    obs=obs,
+                    action=np.full(rows, (gid * 31 + f) % act_mod,
+                                   np.int32),
+                    reward=np.zeros(rows, np.float32), next_obs=obs,
+                    discount=np.ones(rows, np.float32))
+                time.sleep(0.02)
+            c.close()
+        except Exception as e:  # noqa: BLE001 — reported in the verdict
+            errors.append(f"actor {gid}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=actor, args=(g,), daemon=True)
+               for g in range(num_actors)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+
+    step = [0]
+    statuses: list[str] = []
+    critical_flaps = [0]
+    rules_fired: set[str] = set()
+    decisions: list[dict] = []
+
+    def tick(collect_rules: bool = False) -> None:
+        v = fleet.scrape()
+        statuses.append(v.status)
+        if v.status == "critical":
+            critical_flaps[0] += 1
+        if collect_rules and v.status != "ok":
+            rules_fired.update(f.rule for f in v.findings)
+        ds = [d.to_jsonable() for d in autoscaler.observe(v)]
+        decisions.extend(ds)
+        rec = {**fleet.gauges(), **registry.gauges(),
+               **autoscaler.gauges(),
+               "rpc/mass_reconnects":
+                   float(resilience.mass_reconnects() - mass_base),
+               "health/verdict": v.to_jsonable()}
+        if ds:
+            rec["autoscale/decision"] = ds
+        metrics.log(step[0], **rec)
+        step[0] += 1
+        time.sleep(0.03)
+
+    def run_until(pred, min_s: float = 0.0, max_s: float = 15.0,
+                  collect_rules: bool = False) -> bool:
+        t1 = time.monotonic()
+        while True:
+            tick(collect_rules)
+            elapsed = time.monotonic() - t1
+            if elapsed >= min_s and pred():
+                return True
+            if elapsed > max_s:
+                return False
+
+    max_s = deadline / 4
+    # phase A: two-host steady state settles on ok
+    phase_a_ok = run_until(lambda: statuses[-1] == "ok",
+                           min_s=1.0, max_s=max_s)
+
+    # phase B: retire host-1 — graceful drain + manifest-committed shard
+    # export. Its scrape now fails, so the verdict must degrade with
+    # member_unreachable named, and the autoscaler must shrink on it
+    shard = tempfile.mktemp(prefix="churn_shard_")
+    export = ms.export_shard(server1, shard)
+    degraded_reached = run_until(
+        lambda: statuses[-1] == "degraded"
+        and "member_unreachable" in rules_fired,
+        max_s=max_s, collect_rules=True)
+
+    # phase C: host-2 imports the shard (warm boot: rows, PER state, and
+    # the flush-seq dedup map all restore) and joins; host-1 leaves with
+    # its shard lineage recorded. Actors re-run assign_fleet against the
+    # new epoch and reconnect; in-flight resend floors come from the
+    # shard's current holder so nothing double-lands
+    replay2 = ReplayMemory(cap, (2,), np.float32, seed=2)
+    server2, imported = ms.import_shard(replay2, shard)
+    admin.call("fleet_join", token="host-2", host=server2.address[0],
+               port=server2.address[1])
+    admin.call("fleet_leave", token="host-1", importer="host-2")
+    fleet.deregister("host-1")
+    fleet.register("host-2", scrape_at(server2.address))
+    handoff_lost = max(0, export["rows"] - imported["rows"])
+    metrics.log(step[0], **{
+        "fleet/handoff_ms": export["export_ms"] + imported["import_ms"],
+        "fleet/handoff_rows": float(imported["rows"]),
+        "fleet/handoff_lost_rows": float(handoff_lost)})
+    step[0] += 1
+
+    view2 = admin.call("fleet_view")
+    tokens2 = ms.view_tokens(view2)
+    owner2 = {g: t for t, gids in
+              assign_fleet(num_actors, tokens2).items() for g in gids}
+    remapped = 0
+    for gid in range(num_actors):
+        if owner2[gid] == owner0[gid] or clients[gid] is None:
+            continue
+        holder = ms.resolve_importer(view2, owner0[gid])
+        if holder:
+            floor = ms.resend_floor(
+                *ms.view_address(view2, holder), actor_id=gid)
+            clients[gid].resend_floor = max(
+                clients[gid].resend_floor, floor)
+        clients[gid].rehost(*ms.view_address(view2, owner2[gid]),
+                            remap=True)
+        remapped += 1
+
+    # phase D: the fleet heals — stable ok, then the autoscaler's
+    # recovery streak grows actor capacity back (cooldown permitting)
+    recovered = run_until(
+        lambda: len(statuses) >= 3 and statuses[-3:] == ["ok"] * 3,
+        min_s=0.5, max_s=max_s, collect_rules=True)
+    grew_back = run_until(
+        lambda: any(d["action"] == "grow_actors" for d in decisions),
+        max_s=max_s)
+
+    for t in threads:
+        t.join(timeout=deadline)
+    hung = sum(t.is_alive() for t in threads)
+    wall = time.perf_counter() - t0
+    mass = resilience.mass_reconnects() - mass_base
+
+    # labeled-frame ledger across the union of surviving shards: every
+    # id exactly once, and every stored action matches its id's formula
+    # (row integrity through the handoff, not just row count)
+    expected = {g * 1_000_000 + f * 1_000 + r for g in range(num_actors)
+                for f in range(flushes) for r in range(rows)}
+    observed: list[int] = []
+    wrong_actions = 0
+    for rep in (replay0, replay2):
+        n = len(rep)
+        ids = rep.obs[:n, 0].astype(np.int64)
+        observed.extend(ids.tolist())
+        gids = ids // 1_000_000
+        fs = (ids % 1_000_000) // 1_000
+        want = (gids * 31 + fs) % act_mod
+        wrong_actions += int(np.sum(rep.action[:n] != want))
+    lost = len(expected) - len(set(observed))
+    duplicated = len(observed) - len(set(observed))
+
+    metrics.close()
+    server0.close()
+    server2.close()
+    admin.close()
+    health.reset()
+
+    records = load_records(jsonl)
+    slo = slo_problems(records)
+    elastic = elastic_problems(records)
+    shrink_named = any(d["action"] == "shrink_actors"
+                       and d["rule"] == "member_unreachable"
+                       for d in decisions)
+    grow_named = any(d["action"] == "grow_actors"
+                     and d["rule"] == RECOVERY_RULE for d in decisions)
+    skipped = sum(c.resends_skipped for c in clients if c is not None)
+    verdict = {
+        "ok": (not errors and not hung and lost == 0 and duplicated == 0
+               and wrong_actions == 0 and phase_a_ok and degraded_reached
+               and recovered and grew_back and critical_flaps[0] == 0
+               and handoff_lost == 0 and remapped > 0 and mass >= remapped
+               and shrink_named and grow_named
+               and "flush_p99" not in rules_fired
+               and not slo and not elastic),
+        "phase_a_ok": phase_a_ok,
+        "degraded_reached": degraded_reached,
+        "recovered": recovered,
+        "grew_back": grew_back,
+        "critical_flaps": critical_flaps[0],
+        "rules_fired": sorted(rules_fired),
+        "transitions_sent": total,
+        "transitions_stored": len(observed),
+        "lost": lost,
+        "duplicated": duplicated,
+        "wrong_actions": wrong_actions,
+        "handoff_rows": imported["rows"],
+        "handoff_lost_rows": handoff_lost,
+        "handoff_ms": round(export["export_ms"]
+                            + imported["import_ms"], 2),
+        "restored_generation": imported["generation"],
+        "actors_remapped": remapped,
+        "mass_reconnects": mass,
+        "resends_skipped": skipped,
+        "decisions": decisions,
+        "shrink_on_member_unreachable": shrink_named,
+        "grow_on_recovery": grow_named,
+        "fleet_epoch": registry.epoch(),
+        "slo_problems": slo,
+        "elastic_problems": elastic,
+        "hung_actors": hung,
+        "errors": errors,
+        "wall_s": round(wall, 2),
+    }
+    return verdict
+
+
 def _require_clean_gate() -> None:
     """Chaos results must never be reported for code with known race
     findings — refuse to run unless the static-analysis gate is clean."""
@@ -1370,6 +1675,13 @@ if __name__ == "__main__":
         if len(args) > 1:
             kwargs["spike"] = float(args[1])
         verdict = run_learn_divergence_smoke(**kwargs)
+        print(json.dumps(verdict))
+        sys.exit(0 if verdict["ok"] else 1)
+    if args and args[0] in ("churn", "--churn", "elastic"):
+        kwargs = {}
+        if len(args) > 1 and args[1].isdigit():
+            kwargs["num_actors"] = int(args[1])
+        verdict = run_churn_smoke(**kwargs)
         print(json.dumps(verdict))
         sys.exit(0 if verdict["ok"] else 1)
     if args and args[0] in ("durability", "--durability"):

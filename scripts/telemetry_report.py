@@ -162,6 +162,40 @@ def learn_problems(records: list[dict]) -> list[str]:
     return out
 
 
+def elastic_problems(records: list[dict]) -> list[str]:
+    """Elastic-fleet failures ``--strict`` gates on (ISSUE 17): a shard
+    handoff that lost rows, or an autoscaler decision that fired
+    without a named finding — every decision must carry the rule and
+    the burn numbers that triggered it (lineage-traceable), else the
+    capacity change is an unauditable mutation of a production fleet."""
+    out = []
+    lost = [v for v in _series(records, "fleet/handoff_lost_rows")
+            if isinstance(v, (int, float))]
+    if any(v > 0 for v in lost):
+        out.append(f"elastic: shard handoff lost {int(max(lost))} "
+                   "row(s) — the manifest-committed export/import "
+                   "round trip must be lossless")
+    for i, rec in enumerate(records):
+        decisions = rec.get("autoscale/decision")
+        if decisions is None:
+            continue
+        if isinstance(decisions, dict):
+            decisions = [decisions]
+        if not isinstance(decisions, list):
+            out.append(f"elastic: record {i}: autoscale/decision is "
+                       f"{type(decisions).__name__}, not a list")
+            continue
+        for d in decisions:
+            if not isinstance(d, dict) or not d.get("rule"):
+                out.append(f"elastic: record {i}: autoscaler decision "
+                           "without a named rule")
+            elif not all(isinstance(d.get(k), (int, float))
+                         for k in ("burn_fast", "burn_slow")):
+                out.append(f"elastic: record {i}: decision "
+                           f"'{d.get('rule')}' missing burn numbers")
+    return out
+
+
 def _hist_groups(records: list[dict], prefix: str) -> dict[str, dict]:
     """Latest value per histogram-summary group under ``prefix``:
     ``{'fleet/param_pull_ms': {'count': ..., 'p50': ..., ...}, ...}``."""
@@ -403,7 +437,8 @@ def render_report(records: list[dict], last: int = 0) -> str:
                     f"on {f.get('key', '?')}")
 
     problems = (validate_records(records) + _gap_anomalies(records)
-                + slo_problems(records) + learn_problems(records))
+                + slo_problems(records) + learn_problems(records)
+                + elastic_problems(records))
     drops = [v for v in _series(records, "trace/spans_dropped")
              if isinstance(v, (int, float))]
     if drops and drops[-1] > 0:
@@ -437,7 +472,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.strict:
         window = records[-args.last:] if args.last else records
         problems = (validate_records(window) + _gap_anomalies(window)
-                    + slo_problems(window) + learn_problems(window))
+                    + slo_problems(window) + learn_problems(window)
+                    + elastic_problems(window))
         if problems:
             print(f"strict: FAILED ({len(problems)} problem(s), first: "
                   f"{problems[0]})", file=sys.stderr)

@@ -339,11 +339,13 @@ def test_config_schema_parsed_from_real_config():
         config_keys.CONFIG_FILE)
     schema = config_keys.config_schema(cfg_src)
     assert set(schema) == {"net", "replay", "train", "env", "actors",
-                           "mesh", "trace", "inference", "health"}
+                           "mesh", "trace", "inference", "health",
+                           "autoscale"}
     assert "num_actions" in schema["net"]
     assert "server_snapshot_path" in schema["train"]
     assert "cutoff_us" in schema["inference"]
     assert "fast_window_s" in schema["health"]
+    assert "recover_ticks" in schema["autoscale"]
 
 
 # ---------------------------------------------------------------------------

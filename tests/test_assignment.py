@@ -83,6 +83,32 @@ def test_minimal_remap_on_host_join():
     assert moved > 0  # the new host did receive actors
 
 
+def test_minimal_remap_on_multi_host_leave():
+    """Elastic-fleet shrink (ISSUE 17): when SEVERAL hosts leave at
+    once, only the orphaned actors plus a bounded rebalance margin move
+    — survivors keep the overwhelming share of their slices, so the
+    membership epoch bump triggers a handful of reconnects, not a
+    fleet-wide storm. The 0.15 survivor-churn bound is loose; naive
+    modulo reshuffles nearly everything here."""
+    fleet = 64
+    before = host_tokens(6)
+    a = assign_fleet(fleet, before)
+    survivors = tuple(t for t in before if t not in ("host-1", "host-4"))
+    b = assign_fleet(fleet, survivors)
+    owner_a = {g: h for h, v in a.items() for g in v}
+    owner_b = {g: h for h, v in b.items() for g in v}
+    orphaned = set(a["host-1"]) | set(a["host-4"])
+    moved = {g for g in range(fleet) if owner_a[g] != owner_b[g]}
+    assert orphaned <= moved  # every orphan found a new owner
+    survivor_churn = moved - orphaned
+    assert len(survivor_churn) <= fleet * 0.15, sorted(survivor_churn)
+    # the shrunken fleet still holds the balance invariant (no empty
+    # shard: the learn-gate liveness property survives churn)
+    lo, hi = fleet // len(survivors), -(-fleet // len(survivors))
+    for h, v in b.items():
+        assert lo <= len(v) <= hi
+
+
 def test_local_slice_matches_assign_fleet():
     fleet, hosts = 24, 3
     full = assign_fleet(fleet, host_tokens(hosts))

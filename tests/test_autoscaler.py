@@ -1,0 +1,171 @@
+"""Health-driven autoscaler tests (ISSUE 17, actors/autoscaler.py).
+
+The control loop on the PR 12 health plane: verdict findings map to
+grow/shrink decisions, damped by per-dimension cooldown and a
+recovery-streak hysteresis. Every decision must be lineage-traceable
+(rule name + burn numbers), which ``telemetry_report --strict`` gates
+on via ``elastic_problems`` — both directions tested here.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+from distributed_deep_q_tpu.actors.autoscaler import (
+    RECOVERY_RULE, Autoscaler, Decision)
+from distributed_deep_q_tpu.health import HealthFinding, HealthVerdict
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "scripts"))
+
+from telemetry_report import elastic_problems  # noqa: E402
+
+
+def _degraded(rule: str, **kw) -> HealthVerdict:
+    f = HealthFinding(rule=rule, key=kw.pop("key", "k"),
+                      value=kw.pop("value", 9.0),
+                      target=kw.pop("target", 1.0),
+                      burn_fast=kw.pop("burn_fast", 2.0),
+                      burn_slow=kw.pop("burn_slow", 1.5), **kw)
+    return HealthVerdict(status="degraded", findings=(f,))
+
+
+OK = HealthVerdict()
+
+
+def test_ingest_pressure_shrinks_actors_with_provenance():
+    a = Autoscaler(min_actors=2, max_actors=8, step=2, cooldown_s=0.0)
+    ds = a.observe(_degraded("member_unreachable", key="host-1",
+                             member="host-1"), t=0.0)
+    assert len(ds) == 1
+    d = ds[0]
+    assert d.action == "shrink_actors" and d.rule == "member_unreachable"
+    assert (d.from_n, d.to_n) == (8, 6)
+    assert d.member == "host-1"
+    assert a.targets() == (6, 0)
+    # the full SLO-pressure family maps to the same shrink verb
+    for rule in ("ingest_shed", "credit_starvation", "flush_p99",
+                 "staged_growth", "ingest_collapse"):
+        ds = a.observe(_degraded(rule), t=100.0)
+        assert ds and ds[0].action == "shrink_actors"
+        assert ds[0].rule == rule
+        a = Autoscaler(min_actors=2, max_actors=8, step=2, cooldown_s=0.0)
+
+
+def test_shrink_clamps_at_min_actors():
+    a = Autoscaler(min_actors=4, max_actors=5, step=3, cooldown_s=0.0)
+    ds = a.observe(_degraded("ingest_shed"), t=0.0)
+    assert ds[0].to_n == 4  # clamped, not 5 - 3
+    # already at the floor: pressure produces NO decision (nothing to do)
+    assert a.observe(_degraded("ingest_shed"), t=1.0) == []
+
+
+def test_inference_pressure_grows_inference():
+    a = Autoscaler(min_actors=1, max_actors=1, min_inference=1,
+                   max_inference=4, cooldown_s=0.0)
+    for i, rule in enumerate(("infer_latency", "infer_queue_growth",
+                              "infer_shed")):
+        ds = a.observe(_degraded(rule), t=float(i))
+        assert ds and ds[0].action == "grow_inference"
+        assert ds[0].rule == rule
+    assert a.targets()[1] == 4  # clamped at max after three grows
+
+
+def test_recovery_requires_consecutive_ok_streak():
+    """Hysteresis: growth back needs ``recover_ticks`` CONSECUTIVE ok
+    verdicts; one degraded tick resets the streak."""
+    a = Autoscaler(min_actors=2, max_actors=8, step=2, cooldown_s=0.0,
+                   recover_ticks=3)
+    a.observe(_degraded("ingest_shed"), t=0.0)
+    assert a.targets()[0] == 6
+    assert a.observe(OK, t=1.0) == []
+    assert a.observe(OK, t=2.0) == []
+    a.observe(_degraded("ingest_shed"), t=3.0)  # streak reset + shrink
+    assert a.targets()[0] == 4
+    assert a.observe(OK, t=4.0) == []
+    assert a.observe(OK, t=5.0) == []
+    ds = a.observe(OK, t=6.0)  # third consecutive ok: grow
+    assert len(ds) == 1
+    d = ds[0]
+    assert d.action == "grow_actors" and d.rule == RECOVERY_RULE
+    assert (d.from_n, d.to_n) == (4, 6)
+    assert d.value == 3.0 and d.target == 3.0  # provenance = the streak
+
+
+def test_recovery_relaxes_inference_too():
+    a = Autoscaler(min_actors=1, max_actors=1, min_inference=1,
+                   max_inference=4, cooldown_s=0.0, recover_ticks=2)
+    a.observe(_degraded("infer_shed"), t=0.0)
+    assert a.targets() == (1, 2)
+    a.observe(OK, t=1.0)
+    ds = a.observe(OK, t=2.0)
+    assert [d.action for d in ds] == ["shrink_inference"]
+    assert a.targets() == (1, 1)
+
+
+def test_cooldown_blocks_and_counts():
+    a = Autoscaler(min_actors=1, max_actors=8, step=1, cooldown_s=10.0)
+    assert a.observe(_degraded("ingest_shed"), t=0.0)  # fires
+    assert a.observe(_degraded("ingest_shed"), t=5.0) == []  # blocked
+    assert a.gauges()["autoscale/cooldown_blocked"] == 1.0
+    assert a.observe(_degraded("ingest_shed"), t=10.0)  # cooldown over
+    g = a.gauges()
+    assert g["autoscale/decisions"] == 2.0 and g["autoscale/shrink"] == 2.0
+
+
+def test_invalid_bounds_rejected():
+    with pytest.raises(ValueError, match="min_actors"):
+        Autoscaler(min_actors=5, max_actors=2)
+    with pytest.raises(ValueError, match="min_inference"):
+        Autoscaler(min_inference=3, max_inference=1)
+
+
+def test_decision_jsonable_names_rule_and_burns():
+    a = Autoscaler(min_actors=1, max_actors=4, cooldown_s=0.0)
+    d = a.observe(_degraded("flush_p99", burn_fast=3.25,
+                            burn_slow=1.75), t=2.0)[0].to_jsonable()
+    assert d["rule"] == "flush_p99"
+    assert d["burn_fast"] == 3.25 and d["burn_slow"] == 1.75
+    assert d["action"] == "shrink_actors"
+    assert d["from_n"] == 4 and d["to_n"] == 3 and d["t"] == 2.0
+
+
+# -- telemetry_report --strict: the provenance gate -------------------------
+
+
+def _decision_dict(**over) -> dict:
+    base = Decision(action="shrink_actors", rule="ingest_shed", key="k",
+                    member="", value=1.0, target=0.5, burn_fast=2.0,
+                    burn_slow=1.0, from_n=4, to_n=3, t=0.0).to_jsonable()
+    base.update(over)
+    return base
+
+
+def test_elastic_problems_clean_run_passes():
+    records = [
+        {"step": 0, "fleet/handoff_lost_rows": 0.0},
+        {"step": 1, "autoscale/decision": [_decision_dict()]},
+    ]
+    assert elastic_problems(records) == []
+
+
+def test_elastic_problems_flags_lost_handoff_rows():
+    probs = elastic_problems([{"step": 0,
+                               "fleet/handoff_lost_rows": 3.0}])
+    assert len(probs) == 1 and "lost 3" in probs[0]
+
+
+def test_elastic_problems_flags_unnamed_decision():
+    probs = elastic_problems(
+        [{"step": 0, "autoscale/decision": [_decision_dict(rule="")]}])
+    assert len(probs) == 1 and "without a named rule" in probs[0]
+
+
+def test_elastic_problems_flags_missing_burn_numbers():
+    probs = elastic_problems(
+        [{"step": 0,
+          "autoscale/decision": [_decision_dict(burn_fast=None)]}])
+    assert len(probs) == 1 and "missing burn numbers" in probs[0]

@@ -1,0 +1,293 @@
+"""Elastic-fleet membership tests (ISSUE 17, actors/membership.py).
+
+Three layers, in load-bearing order:
+
+- **Registry semantics** — epoch monotonicity, lease liveness distinct
+  from heartbeats, departed→importer lineage (the resend-floor chain).
+- **Wire integration** — the four ``fleet_*`` verbs ride the existing
+  v4 CRC frame through a real ``ReplayFeedServer`` (delegated from its
+  ``_dispatch``), plus the ``stream_seq`` floor probe.
+- **Shard handoff** — a departing server exports its replay shard
+  through the PR 6 ``GenerationStore`` and a fresh server warm-boots
+  it: rows survive, the ``(actor_id, flush_seq)`` dedup map travels
+  (resends after the remap dedup server-side), and a TORN handoff is
+  quarantined with fallback to the previous good generation — never a
+  half-shard.
+
+The raw ``open(...).truncate`` below damages a snapshot on purpose;
+``analysis/atomic_writes.py`` scans the package, not tests.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_deep_q_tpu import health
+from distributed_deep_q_tpu.actors import membership as ms
+from distributed_deep_q_tpu.actors.membership import MembershipRegistry
+from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
+from distributed_deep_q_tpu.rpc.replay_server import (
+    ReplayFeedClient, ReplayFeedServer)
+from distributed_deep_q_tpu.utils.durability import GEN_PREFIX
+
+
+@pytest.fixture
+def feed_server():
+    created = []
+
+    def make(replay=None, **kw):
+        if replay is None:
+            replay = ReplayMemory(256, (2,))
+        s = ReplayFeedServer(replay, **kw)
+        created.append(s)
+        return s
+
+    yield make
+    for s in created:
+        s.close()
+
+
+def _vector_batch(n: int, base: float = 0.0) -> dict:
+    ids = base + np.arange(n, dtype=np.float32)
+    obs = np.stack([ids, ids], axis=1)
+    return dict(obs=obs, action=np.zeros(n, np.int32),
+                reward=np.zeros(n, np.float32), next_obs=obs,
+                discount=np.ones(n, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_join_leave_bump_epoch_and_counters():
+    reg = MembershipRegistry()
+    assert reg.epoch() == 0
+    assert reg.join("host-0", "127.0.0.1", 1000) == 1
+    assert reg.join("host-1", "127.0.0.1", 1001) == 2
+    # re-join (re-address) is a membership event too: observers must
+    # notice the address change via the epoch watch
+    assert reg.join("host-1", "127.0.0.1", 2001) == 3
+    assert reg.leave("host-0") == 4
+    g = reg.gauges()
+    assert g["fleet/epoch"] == 4.0
+    assert g["fleet/members"] == 1.0
+    assert g["fleet/joins"] == 3.0 and g["fleet/leaves"] == 1.0
+    assert g["fleet/handoffs"] == 0.0  # shard-less drain, no lineage
+
+
+def test_join_rejects_empty_token():
+    with pytest.raises(ValueError, match="non-empty"):
+        MembershipRegistry().join("", "127.0.0.1", 1000)
+
+
+def test_lease_expiry_is_an_epoch_bump_like_leave():
+    """A host that stops renewing past ``lease_s`` is expired by the
+    sweep — same epoch bump as a voluntary leave, so the actor-side
+    remap path is identical; ``renew`` on a non-member says re-join."""
+    reg = MembershipRegistry(lease_s=10.0)
+    reg.join("host-0", "127.0.0.1", 1000)
+    reg.join("host-1", "127.0.0.1", 1001)
+    assert reg.renew("host-0") is True
+    assert reg.expire() == ()  # fresh leases survive a sweep "now"
+    import time
+    far = time.monotonic() + 100.0
+    assert set(reg.expire(now=far)) == {"host-0", "host-1"}
+    assert reg.renew("host-0") is False  # expired: must re-join
+    g = reg.gauges()
+    assert g["fleet/members"] == 0.0
+    assert g["fleet/lease_expired"] == 2.0
+    assert g["fleet/epoch"] == 4.0  # 2 joins + 2 expiries
+
+
+def test_lineage_records_handoff_and_rejoin_clears_it():
+    reg = MembershipRegistry()
+    reg.join("host-0", "127.0.0.1", 1000)
+    reg.join("host-1", "127.0.0.1", 1001)
+    reg.leave("host-0", importer="host-1")
+    v = reg.view()
+    assert ms.resolve_importer(v, "host-0") == "host-1"
+    assert reg.gauges()["fleet/handoffs"] == 1.0
+    # the token comes back: it owns its shard again, lineage entry gone
+    reg.join("host-0", "127.0.0.1", 3000)
+    v = reg.view()
+    assert ms.resolve_importer(v, "host-0") == "host-0"
+
+
+def test_view_helpers_and_transitive_lineage():
+    reg = MembershipRegistry()
+    reg.join("host-2", "127.0.0.1", 1002)
+    reg.join("host-0", "127.0.0.1", 1000)
+    v = reg.view()
+    assert ms.view_tokens(v) == ("host-0", "host-2")  # sorted
+    assert ms.view_address(v, "host-2") == ("127.0.0.1", 1002)
+    # chained handoffs resolve transitively to the live end of the chain
+    reg.leave("host-0", importer="host-1")
+    reg.join("host-1", "127.0.0.1", 1001)
+    reg.leave("host-1", importer="host-2")
+    v = reg.view()
+    assert ms.resolve_importer(v, "host-0") == "host-2"
+    # a chain that dead-ends outside the fleet resolves to "" (the
+    # caller falls back to a plain remap, no floor)
+    assert ms.resolve_importer(v, "host-9") == ""
+
+
+def test_unknown_fleet_method_is_an_error_reply():
+    reg = MembershipRegistry()
+    assert "error" in reg._dispatch({"method": "fleet_destroy"})
+
+
+# ---------------------------------------------------------------------------
+# Wire integration: fleet verbs + stream_seq through a real server
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_verbs_ride_the_replay_wire(feed_server):
+    server = feed_server()
+    server.attach_membership(MembershipRegistry())
+    host, port = server.address
+    c = ReplayFeedClient(host, port, actor_id=1)
+    try:
+        r = c.call("fleet_join", token="host-0", host=host, port=port)
+        assert r["ok"] and r["epoch"] == 1
+        r = c.call("fleet_join", token="host-1", host=host, port=port + 1)
+        assert r["epoch"] == 2
+        assert c.call("fleet_lease", token="host-0")["ok"] is True
+        v = c.call("fleet_view")
+        assert ms.view_tokens(v) == ("host-0", "host-1")
+        r = c.call("fleet_leave", token="host-1", importer="host-0")
+        assert r["ok"] and r["epoch"] == 3
+        v = c.call("fleet_view")
+        assert ms.view_tokens(v) == ("host-0",)
+        assert ms.resolve_importer(v, "host-1") == "host-0"
+    finally:
+        c.close()
+
+
+def test_fleet_verbs_without_registry_error_cleanly(feed_server):
+    server = feed_server()  # no attach_membership: not the seed host
+    host, port = server.address
+    c = ReplayFeedClient(host, port, actor_id=1)
+    try:
+        assert "error" in c.call("fleet_view")
+    finally:
+        c.close()
+
+
+def test_stream_seq_reports_landed_floor(feed_server):
+    server = feed_server()
+    host, port = server.address
+    c = ReplayFeedClient(host, port, actor_id=7)
+    try:
+        assert c.call("stream_seq")["seq"] == -1  # nothing landed yet
+        c.call("add_transitions", flush_seq=5, **_vector_batch(2))
+        assert c.call("stream_seq")["seq"] == 5
+    finally:
+        c.close()
+    # the module helper opens its own connection (the remap path)
+    assert ms.resend_floor(host, port, actor_id=7) == 5
+    assert ms.resend_floor(host, port, actor_id=99) == -1
+
+
+# ---------------------------------------------------------------------------
+# Shard handoff: GenerationStore round trip
+# ---------------------------------------------------------------------------
+
+
+def test_shard_export_import_round_trip(feed_server, tmp_path):
+    """The departing host's rows AND dedup map survive the handoff: a
+    remapped actor resending its un-acked flush to the importer dedups
+    server-side instead of double-inserting."""
+    snap = str(tmp_path / "handoff")
+    server = feed_server()
+    host, port = server.address
+    c = ReplayFeedClient(host, port, actor_id=3)
+    try:
+        c.call("add_transitions", flush_seq=1, **_vector_batch(4))
+        c.call("add_transitions", flush_seq=2, **_vector_batch(4, base=50))
+    finally:
+        c.close()
+    export = ms.export_shard(server, snap)
+    assert export["rows"] == 8 and export["export_ms"] >= 0.0
+
+    replay2 = ReplayMemory(256, (2,))
+    server2, receipt = ms.import_shard(replay2, snap)
+    try:
+        assert receipt["rows"] == 8 == len(replay2)
+        assert receipt["generation"] == 0  # committed handoff generation
+        h2, p2 = server2.address
+        c2 = ReplayFeedClient(h2, p2, actor_id=3)
+        try:
+            # the in-flight resend: seq 2 already landed pre-handoff
+            r = c2.call("add_transitions", flush_seq=2,
+                        **_vector_batch(4, base=50))
+            assert r.get("duplicate") is True
+            assert len(replay2) == 8  # no double insert
+            # the stream then resumes past the restored floor
+            assert c2.call("stream_seq")["seq"] == 2
+            r = c2.call("add_transitions", flush_seq=3,
+                        **_vector_batch(2, base=100))
+            assert not r.get("duplicate") and len(replay2) == 10
+        finally:
+            c2.close()
+    finally:
+        server2.close()
+
+
+def test_torn_handoff_quarantines_and_falls_back(feed_server, tmp_path):
+    """A crash mid-export leaves a torn newest generation; the importer
+    must quarantine it and warm-boot the previous good one — a stale
+    shard beats a corrupt one."""
+    snap = str(tmp_path / "torn")
+    server = feed_server()
+    host, port = server.address
+    c = ReplayFeedClient(host, port, actor_id=1)
+    try:
+        c.call("add_transitions", flush_seq=1, **_vector_batch(3))
+        server.snapshot(snap)  # generation 0: the previous good state
+        c.call("add_transitions", flush_seq=2, **_vector_batch(3, base=50))
+    finally:
+        c.close()
+    ms.export_shard(server, snap)  # generation 1: the handoff proper
+    victim = os.path.join(snap, f"{GEN_PREFIX}00000001", "server.npz")
+    with open(victim, "r+b") as f:
+        f.truncate(32)  # tear the payload: CRC fails at import
+
+    replay2 = ReplayMemory(256, (2,))
+    server2, receipt = ms.import_shard(replay2, snap)
+    try:
+        assert receipt["generation"] == 0  # fell back, did not crash
+        assert receipt["rows"] == 3 == len(replay2)
+        assert server2.telemetry.snapshot_quarantined == 1
+    finally:
+        server2.close()
+
+
+# ---------------------------------------------------------------------------
+# FleetHealth deregister: a departed member stops burning the budget
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_health_deregister_returns_verdict_to_ok():
+    health.configure(enabled=True)
+    try:
+        fleet = health.FleetHealth()
+        fleet.register("host-0",
+                       lambda: health.verdict_to_wire(health.NULL_VERDICT))
+
+        def dead():
+            raise ConnectionRefusedError("gone")
+
+        fleet.register("host-1", dead)
+        v = fleet.scrape()
+        assert not v.ok
+        assert any(f.rule == "member_unreachable" and f.key == "host-1"
+                   for f in v.findings)
+        assert fleet.deregister("host-1") is True
+        assert fleet.deregister("host-1") is False  # already gone
+        assert fleet.scrape().ok
+    finally:
+        health.reset()
