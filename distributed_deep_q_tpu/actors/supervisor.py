@@ -192,7 +192,7 @@ class _ActorComms:
         hb = cfg.actors.heartbeat_period
         if hb:
             threading.Thread(target=self._beat, args=(float(hb),),
-                             daemon=True).start()
+                             name="actor-heartbeat", daemon=True).start()
 
     def _beat(self, period: float) -> None:
         # transient-failure policy (VERDICT r4 weak #5 / ADVICE): a network

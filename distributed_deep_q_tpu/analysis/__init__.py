@@ -1,12 +1,19 @@
 """Repo-native static analysis — machine-checked concurrency/JAX/RPC
 invariants.
 
-Six passes, one entry point:
+Eight passes, one entry point:
 
-- ``locks``          — guarded-attribute lock discipline + static
-                       lock-order deadlock detection
+- ``locks``          — guarded-attribute lock discipline, static
+                       lock-order deadlock detection, CV wait/notify
+                       discipline
+- ``threads``        — thread-lifecycle registry: every spawn site
+                       declares its owner, stop mechanism, join site
+- ``blocking``       — blocking calls (socket/sleep/fsync/device_put/
+                       …) while a registered lock is held, expanded
+                       interprocedurally
 - ``purity``         — side effects inside jit/pmap/shard_map traces
-- ``protocol_drift`` — RPC client/server/wire skew
+- ``protocol_drift`` — RPC client/server/wire skew + wire-verb resend
+                       (idempotence) classes
 - ``config_keys``    — ``cfg.<section>.<field>`` existence
 - ``atomic_writes``  — raw binary writes bypassing the durability plane
 - ``metric_keys``    — metric names vs the declared registry; span
@@ -24,9 +31,42 @@ import os
 
 from distributed_deep_q_tpu.analysis.core import Finding, Source
 from distributed_deep_q_tpu.analysis import (  # noqa: F401
-    atomic_writes, config_keys, locks, metric_keys, protocol_drift, purity)
+    atomic_writes, blocking, config_keys, locks, metric_keys,
+    protocol_drift, purity, threads)
 
-__all__ = ["Finding", "Source", "run_all", "repo_root"]
+__all__ = ["Finding", "Source", "KNOWN_RULES", "run_all", "repo_root"]
+
+# every rule the suite can emit — the gate validates ``--rule`` prefixes
+# against this table so a typo'd filter fails loudly instead of
+# silently matching nothing
+KNOWN_RULES = (
+    "locks.unguarded",
+    "locks.order-cycle",
+    "locks.cv-wait-no-loop",
+    "locks.cv-notify-unheld",
+    "threads.unregistered",
+    "threads.spec-mismatch",
+    "threads.no-join",
+    "threads.no-stop",
+    "threads.stop-unguarded",
+    "blocking.under-lock",
+    "purity.print",
+    "purity.logging",
+    "purity.time",
+    "purity.host-rng",
+    "purity.host-sync",
+    "purity.captured-write",
+    "protocol.unhandled-method",
+    "protocol.orphan-handler",
+    "protocol.wire-skew",
+    "protocol.unclassified-verb",
+    "protocol.stale-verb-class",
+    "protocol.unsafe-resend",
+    "config.unknown-key",
+    "durability.raw-write",
+    "metric_keys.unknown-metric",
+    "metric_keys.unknown-span",
+)
 
 
 def repo_root() -> str:
@@ -39,6 +79,8 @@ def run_all(root: str | None = None) -> list[Finding]:
     root = root or repo_root()
     findings: list[Finding] = []
     findings += locks.check(root)
+    findings += threads.check(root)
+    findings += blocking.check(root)
     findings += purity.check(root)
     findings += protocol_drift.check(root)
     findings += config_keys.check(root)

@@ -79,9 +79,7 @@ def check_sources(sources: list[Source]) -> list[Finding]:
     for src in sources:
         if src.path in EXEMPT_FILES:
             continue
-        for node in ast.walk(src.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in src.nodes(ast.Call):
             name = call_name(node)
             if name is None:
                 continue

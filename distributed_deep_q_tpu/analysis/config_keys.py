@@ -68,8 +68,13 @@ def check_sources(schema: dict[str, set[str]],
                   sources: list[Source]) -> list[Finding]:
     out: list[Finding] = []
     for src in sources:
-        for node in ast.walk(src.tree):
-            if not isinstance(node, ast.Attribute):
+        for node in src.nodes(ast.Attribute):
+            # a checkable chain is <root>.<section>.<field>[...]; the
+            # node ending at <field> has <section> one link in — filter
+            # on that before building the full dotted chain (most
+            # attribute nodes in the tree are single-link self.x/np.y)
+            val = node.value
+            if not isinstance(val, ast.Attribute) or val.attr not in schema:
                 continue
             chain = dotted(node)
             if chain is None:

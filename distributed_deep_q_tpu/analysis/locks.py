@@ -20,6 +20,14 @@ This pass makes them machine-checked:
 - A lock-ORDER graph is built from lexically nested ``with`` blocks
   over known lock names; a cycle is a static deadlock →
   ``locks.order-cycle``.
+- Condition-variable discipline (the registry's ``conditions`` set
+  names which lock attrs are CVs): a ``<cv>.wait(...)`` not lexically
+  inside a ``while`` loop is ``locks.cv-wait-no-loop`` — a woken
+  waiter must re-check its predicate (spurious wakeups, stolen
+  wakeups, timeouts); ``wait_for`` carries its predicate and is
+  exempt. A ``notify``/``notify_all`` without lexically holding the
+  owning CV is ``locks.cv-notify-unheld`` (it raises at runtime, but
+  only on the path that reaches it).
 
 Registering a new guarded field = one line in ``DEFAULT_REGISTRY``.
 """
@@ -35,6 +43,8 @@ from distributed_deep_q_tpu.analysis.core import (
 
 RULE_UNGUARDED = "locks.unguarded"
 RULE_CYCLE = "locks.order-cycle"
+RULE_CV_WAIT = "locks.cv-wait-no-loop"
+RULE_CV_NOTIFY = "locks.cv-notify-unheld"
 
 
 @dataclass(frozen=True)
@@ -58,9 +68,13 @@ class LockRegistry:
         {"__init__", "_restore", "_load_generation", "_reset_boot_state"})
     # repo-relative files this pass walks
     files: tuple[str, ...] = ()
+    # lock attrs that are threading.Condition objects — their wait/
+    # notify calls get the CV-discipline rules
+    conditions: frozenset = frozenset()
 
     def lock_names(self) -> set[str]:
         names = {g.lock for g in self.attrs.values()}
+        names.update(self.conditions)  # a CV is a lock when entered
         for table in self.globals.values():
             names.update(table.values())
         return names
@@ -167,6 +181,7 @@ DEFAULT_REGISTRY = LockRegistry(
         "requests":         Guard("_lock", "InferenceTelemetry"),
         "sheds":            Guard("_lock", "InferenceTelemetry"),
         "wire_errors":      Guard("_lock", "InferenceTelemetry"),
+        "reply_timeouts":   Guard("_lock", "InferenceTelemetry"),
         "latency_ms":       Guard("_lock", "InferenceTelemetry"),
         "batch_rows":       Guard("_lock", "InferenceTelemetry"),
         "forward_ms":       Guard("_lock", "InferenceTelemetry"),
@@ -217,6 +232,9 @@ DEFAULT_REGISTRY = LockRegistry(
     globals={
         "native/__init__.py": {"_lib": "_lock", "_tried": "_lock"},
     },
+    # the condition variables: the ingest drain's and inference
+    # microbatcher's _cv, and the replay server's shutdown-drain CV
+    conditions=frozenset({"_cv", "_inflight_cv"}),
     files=(
         "distributed_deep_q_tpu/rpc/flowcontrol.py",
         "distributed_deep_q_tpu/rpc/replay_server.py",
@@ -247,6 +265,10 @@ class _Walker(ast.NodeVisitor):
         self.held: list[str] = []        # dotted lock exprs, e.g. self._lock
         self.classes: list[str] = []
         self.funcs: list[str] = []
+        # lexical scope markers: "f" per enclosing function, "w" per
+        # enclosing while — a CV wait is loop-checked iff a "w" follows
+        # the innermost "f" (a while in an OUTER function doesn't count)
+        self.scope: list[str] = []
         self.globals_table = next(
             (t for suffix, t in reg.globals.items()
              if src.path.replace(os.sep, "/").endswith(suffix)), {})
@@ -261,11 +283,26 @@ class _Walker(ast.NodeVisitor):
 
     def _visit_func(self, node) -> None:
         self.funcs.append(getattr(node, "name", "<lambda>"))
+        self.scope.append("f")
         self.generic_visit(node)
+        self.scope.pop()
         self.funcs.pop()
 
     visit_FunctionDef = _visit_func
     visit_AsyncFunctionDef = _visit_func
+
+    def visit_While(self, node: ast.While) -> None:
+        self.scope.append("w")
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def _in_while(self) -> bool:
+        for marker in reversed(self.scope):
+            if marker == "w":
+                return True
+            if marker == "f":
+                return False
+        return False
 
     def visit_With(self, node: ast.With) -> None:
         taken: list[str] = []
@@ -321,6 +358,28 @@ class _Walker(ast.NodeVisitor):
                     f"access to {recv}.{node.attr} outside "
                     f"'with {recv}.{guard.lock}:' "
                     f"(guarded field of {guard.owner})", self.out)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted(node.func)
+        if name is not None and "." in name:
+            recv, method = name.rsplit(".", 1)
+            cv = recv.rsplit(".", 1)[-1]
+            if cv in self.reg.conditions and not self._exempt():
+                if method == "wait" and not self._in_while():
+                    # wait_for is exempt: it re-checks its predicate
+                    self.src.finding(
+                        RULE_CV_WAIT, node,
+                        f"{recv}.wait() outside a while-predicate loop — "
+                        "a woken waiter must re-check its condition "
+                        "(spurious/stolen wakeups, timeouts)", self.out)
+                elif method in ("notify", "notify_all") \
+                        and recv not in self.held:
+                    self.src.finding(
+                        RULE_CV_NOTIFY, node,
+                        f"{recv}.{method}() without lexically holding "
+                        f"'with {recv}:' — raises RuntimeError on the "
+                        "path that reaches it", self.out)
         self.generic_visit(node)
 
     def visit_Name(self, node: ast.Name) -> None:

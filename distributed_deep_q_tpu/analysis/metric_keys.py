@@ -109,6 +109,7 @@ REGISTRY = frozenset({
     "inference/requests",
     "inference/sheds",
     "inference/wire_errors",
+    "inference/reply_timeouts",
     "inference/queued_rows",
     "inference/compiled_buckets",
     # multi-host sharded replay (ISSUE 10): per-shard data-plane gauges
@@ -207,7 +208,11 @@ def tracer_tables(tracing_src: Source) -> dict[str, frozenset[str]]:
     return out
 
 
-class _Walker(ast.NodeVisitor):
+class _Walker:
+    """Driven over ``Source.walk()`` — all calls first (claiming their
+    literal args), then all constants — so the claim set is complete
+    before any constant is judged."""
+
     def __init__(self, src: Source, registry: frozenset,
                  tables: dict[str, frozenset[str]], out: list[Finding]):
         self.src = src
@@ -220,6 +225,13 @@ class _Walker(ast.NodeVisitor):
         self._claimed: set[int] = set()
 
     def visit_Call(self, node: ast.Call) -> None:
+        # cheap tail filter before building the dotted chain — almost
+        # no call in the tree targets an emitter or span function
+        func = node.func
+        tail = (func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else None)
+        if tail not in EMITTERS and tail not in SPAN_FNS:
+            return
         name = dotted(node.func) or ""
         parts = name.split(".")
         arg = node.args[0] if node.args else None
@@ -244,7 +256,6 @@ class _Walker(ast.NodeVisitor):
                     RULE_METRIC, node,
                     f"metric name {lit!r} is not declared in "
                     "analysis/metric_keys.py REGISTRY", self.out)
-        self.generic_visit(node)
 
     def visit_Constant(self, node: ast.Constant) -> None:
         if isinstance(node.value, str) and id(node) not in self._claimed \
@@ -261,7 +272,11 @@ def check_sources(sources: list[Source], tracing_src: Source,
     tables = tracer_tables(tracing_src)
     out: list[Finding] = []
     for src in sources:
-        _Walker(src, registry, tables, out).visit(src.tree)
+        walker = _Walker(src, registry, tables, out)
+        for node in src.nodes(ast.Call):
+            walker.visit_Call(node)
+        for node in src.nodes(ast.Constant):
+            walker.visit_Constant(node)
     return out
 
 

@@ -16,17 +16,40 @@ silent ``{"error": "unknown method ..."}``) under traffic:
   ``encode`` but not ``_decode`` (or vice versa) in ``rpc/protocol.py``
   — an encode/decode pairing break.
 
-Registering a new RPC method = adding the ``method == "X"`` branch and
-at least one literal call site; the pass needs no edits.
+Idempotence classes (ISSUE 18): the resilient client's ``call()``
+retries on any failure, so a lost ACK means the server may execute a
+verb TWICE. Every handled verb must therefore declare its resend
+semantics in ``VERB_CLASSES`` — ``idempotent`` (a duplicate delivery
+converges to the same state), ``dedup_keyed`` (duplicates are dropped
+server-side by an explicit key, e.g. ``add_transitions``' per-actor
+``flush_seq`` stamp), or ``unsafe`` (a duplicate corrupts state).
+
+- ``protocol.unclassified-verb``: a ``_dispatch`` branch whose verb has
+  no class — resend semantics living only in comments.
+- ``protocol.stale-verb-class``: a class entry with no handler left.
+- ``protocol.unsafe-resend``: a literal ``.call("X")`` — the RETRYING
+  resend path — on a verb classified ``unsafe``. Unsafe verbs must go
+  through ``call_once`` (single attempt) or gain a dedup key.
+
+Registering a new RPC method = adding the ``method == "X"`` branch, at
+least one literal call site, and one ``VERB_CLASSES`` line.
 """
 
 from __future__ import annotations
 
 import ast
 import os
+import re
 
 from distributed_deep_q_tpu.analysis.core import (
-    Finding, Source, call_name, iter_py_files, load_sources)
+    Finding, Source, call_name, iter_py_files)
+
+# text pre-filter for emitter files: ``emitted_methods`` only matches
+# AST calls whose target tail is ``call``/``call_once``, and any such
+# call spells ``call``/``call_once`` directly before its ``(`` in
+# source (modulo whitespace). Files without the token cannot emit, so
+# their parse — the dominant cost of scanning tests/ — is skipped.
+_EMIT_HINT = re.compile(r"\bcall(?:_once)?\s*\(")
 
 # every server-side dispatch table on the wire protocol: the replay
 # feed and (ISSUE 9) the batched inference plane. The two planes share
@@ -41,6 +64,43 @@ SERVER_TABLES = (
 )
 PROTOCOL_FILE = "distributed_deep_q_tpu/rpc/protocol.py"
 EMITTER_DIRS = ("distributed_deep_q_tpu", "scripts", "tests")
+
+IDEMPOTENT, DEDUP_KEYED, UNSAFE = "idempotent", "dedup_keyed", "unsafe"
+
+# resend semantics of every wire verb — what happens when the resilient
+# client's retry loop delivers it twice (lost ACK, reconnect replay):
+VERB_CLASSES = {
+    # pure function of (θ, obs); the shed path re-sends the SAME
+    # observations, so a duplicate forward returns the same actions
+    "infer": IDEMPOTENT,
+    # dedup map keyed (actor_id, flush_seq) under replay_lock: a
+    # replayed seq is counted as duplicate_flush and dropped — the
+    # exactly-once backbone of the resilience plane
+    "add_transitions": DEDUP_KEYED,
+    # cached θ frame read; have_version refresh is a no-op reply
+    "get_params": IDEMPOTENT,
+    # liveness stamp: last_seen[actor] = now, monotone overwrite
+    "heartbeat": IDEMPOTENT,
+    # reads of telemetry / health rings; a duplicate health scrape
+    # re-samples a time-windowed ring — benign double sample
+    "stats": IDEMPOTENT,
+    "health": IDEMPOTENT,
+    # pure read of the landed-flush floor (elastic remap support)
+    "stream_seq": IDEMPOTENT,
+    # seal current slot + drop the actor's flush_seq stamp; re-sealing
+    # an already-sealed slot and re-popping an absent stamp are no-ops
+    "reset_stream": IDEMPOTENT,
+    # membership state converges: re-join supersedes the member row,
+    # leaving an absent member is a pop of nothing, a lease renew
+    # extends monotonically from `now`. Each duplicate delivery still
+    # bumps the epoch — observers re-run the SAME assignment, so the
+    # churn is benign (and counted in fleet stats)
+    "fleet_join": IDEMPOTENT,
+    "fleet_leave": IDEMPOTENT,
+    "fleet_lease": IDEMPOTENT,
+    # pure read of the epoch-numbered member table
+    "fleet_view": IDEMPOTENT,
+}
 
 
 def dispatch_handlers(server_src: Source,
@@ -70,18 +130,20 @@ def dispatch_handlers(server_src: Source,
     return handlers
 
 
-def emitted_methods(sources: list[Source]) -> list[tuple[str, Source, int]]:
+def emitted_methods(sources: list[Source],
+                    tails: tuple[str, ...] = ("call", "call_once"),
+                    ) -> list[tuple[str, Source, int]]:
     """Literal first arguments of ``.call(...)`` / ``.call_once(...)``
     (also a bare ``call("X")`` — the heartbeat thread binds the method
-    to a local)."""
+    to a local). ``tails=("call",)`` restricts to the RETRYING emit
+    surface for the unsafe-resend check."""
     out: list[tuple[str, Source, int]] = []
     for src in sources:
-        for node in ast.walk(src.tree):
-            if not isinstance(node, ast.Call) or not node.args:
+        for node in src.nodes(ast.Call):
+            if not node.args:
                 continue
             name = call_name(node)
-            if name is None or name.rsplit(".", 1)[-1] not in (
-                    "call", "call_once"):
+            if name is None or name.rsplit(".", 1)[-1] not in tails:
                 continue
             arg = node.args[0]
             if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
@@ -128,11 +190,15 @@ def wire_kind_skew(proto_src: Source, out: list[Finding]) -> None:
 
 
 def check_sources(server_src, proto_src: Source,
-                  emitter_sources: list[Source]) -> list[Finding]:
+                  emitter_sources: list[Source],
+                  verb_classes: dict[str, str] | None = None
+                  ) -> list[Finding]:
     """``server_src`` is one ``Source`` (checked as ``ReplayFeedServer``)
     or a list of ``(Source, class_name)`` pairs — one per dispatch
     table. Handlers are unioned for the unhandled-method direction; the
-    orphan direction attributes each handler to its own table."""
+    orphan direction attributes each handler to its own table. With
+    ``verb_classes``, every handled verb must carry a resend class and
+    ``unsafe`` verbs must never ride the retrying ``.call`` path."""
     if isinstance(server_src, Source):
         server_src = [(server_src, "ReplayFeedServer")]
     out: list[Finding] = []
@@ -140,6 +206,32 @@ def check_sources(server_src, proto_src: Source,
               for src, cls in server_src]
     handled = {m for _, _, handlers in tables for m in handlers}
     emitted = emitted_methods(emitter_sources)
+    if verb_classes is not None:
+        for table_src, cls, handlers in tables:
+            for method, line in sorted(handlers.items()):
+                if method not in verb_classes:
+                    table_src.finding(
+                        "protocol.unclassified-verb", line,
+                        f"{cls}._dispatch handles {method!r} but "
+                        "VERB_CLASSES declares no resend semantics for "
+                        "it (idempotent | dedup_keyed | unsafe)", out)
+        for method, klass in sorted(verb_classes.items()):
+            if method not in handled and tables:
+                tables[0][0].finding(
+                    "protocol.stale-verb-class", 1,
+                    f"VERB_CLASSES declares {method!r} as {klass} but no "
+                    "server _dispatch table handles it", out)
+        unsafe = {m for m, k in verb_classes.items() if k == UNSAFE}
+        if unsafe:
+            for method, src, line in emitted_methods(
+                    emitter_sources, tails=("call",)):
+                if method in unsafe:
+                    src.finding(
+                        "protocol.unsafe-resend", line,
+                        f".call({method!r}) rides the resilient client's "
+                        "retry path, but the verb is classified unsafe "
+                        "under resend — use call_once or add a dedup "
+                        "key", out)
     for method, src, line in emitted:
         if method not in handled:
             src.finding(
@@ -171,5 +263,14 @@ def check(repo_root: str) -> list[Finding]:
         full = os.path.join(repo_root, d)
         if os.path.isdir(full):
             paths.extend(iter_py_files(full))
-    return check_sources(server_srcs, proto_src,
-                         load_sources(repo_root, sorted(set(paths))))
+    emitters: list[Source] = []
+    for p in sorted(set(paths)):
+        try:
+            with open(p, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        if _EMIT_HINT.search(text):
+            emitters.append(Source.load(p, os.path.relpath(p, repo_root)))
+    return check_sources(server_srcs, proto_src, emitters,
+                         verb_classes=VERB_CLASSES)

@@ -74,9 +74,8 @@ class _ModuleIndex:
         self.src = src
         self.by_name: dict[str, list[_FuncNode]] = {}
         self.top_level: set[str] = set()
-        for node in ast.walk(src.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self.by_name.setdefault(node.name, []).append(node)
+        for node in src.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            self.by_name.setdefault(node.name, []).append(node)
         for node in src.tree.body:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self.top_level.add(node.name)
@@ -96,14 +95,14 @@ def _alias_targets(value: ast.AST) -> list[str]:
     return []
 
 
-def _local_aliases(tree: ast.AST) -> dict[str, list[str]]:
+def _local_aliases(src: Source) -> dict[str, list[str]]:
     """``x = f`` / ``x = partial(f, ...)`` / ``x = f if gate else g``
     anywhere in the module → {x: [f, ...]} for resolving wrapper
     arguments passed by name. The same alias name in different scopes
     (``kernel = partial(...)`` in two builders) keeps every target."""
     out: dict[str, list[str]] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+    for node in src.nodes(ast.Assign):
+        if len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name):
             for name in _alias_targets(node.value):
                 out.setdefault(node.targets[0].id, []).append(name)
@@ -119,7 +118,7 @@ def _collect_roots(idx: _ModuleIndex) -> list[_FuncNode]:
             seen.add(id(node))
             roots.append(node)
 
-    aliases = _local_aliases(idx.src.tree)
+    aliases = _local_aliases(idx.src)
 
     def resolve(arg: ast.AST) -> None:
         arg = _unwrap_partial(arg)
@@ -133,20 +132,19 @@ def _collect_roots(idx: _ModuleIndex) -> list[_FuncNode]:
                 for fn in idx.by_name.get(name, []):
                     add(fn)
 
-    for node in ast.walk(idx.src.tree):
-        if isinstance(node, ast.Call) \
-                and _last(call_name(node)) in JIT_WRAPPERS and node.args:
+    for node in idx.src.nodes(ast.Call):
+        if _last(call_name(node)) in JIT_WRAPPERS and node.args:
             resolve(node.args[0])
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for dec in node.decorator_list:
-                target = dec.func if isinstance(dec, ast.Call) else dec
-                name = _last(dotted(target))
-                if name in JIT_WRAPPERS:
-                    add(node)
-                elif name == "partial" and isinstance(dec, ast.Call) \
-                        and dec.args \
-                        and _last(dotted(dec.args[0])) in JIT_WRAPPERS:
-                    add(node)
+    for node in idx.src.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = _last(dotted(target))
+            if name in JIT_WRAPPERS:
+                add(node)
+            elif name == "partial" and isinstance(dec, ast.Call) \
+                    and dec.args \
+                    and _last(dotted(dec.args[0])) in JIT_WRAPPERS:
+                add(node)
     return roots
 
 

@@ -41,7 +41,10 @@ def _build() -> bool:
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
            "-o", tmp, _SRC]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        subprocess.run(  # ddq: allow(blocking.under-lock) — build-once
+            # gate: _lock exists to make the first caller compile while
+            # the rest wait; nothing hot shares this module lock
+            cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)
         return True
     except (OSError, subprocess.SubprocessError):

@@ -105,6 +105,7 @@ class InferenceTelemetry:
         self.requests = 0
         self.sheds = 0
         self.wire_errors = 0
+        self.reply_timeouts = 0
 
     def record_reply(self, ms: float) -> None:
         with self._lock:
@@ -119,6 +120,10 @@ class InferenceTelemetry:
         with self._lock:
             self.wire_errors += 1
 
+    def record_reply_timeout(self) -> None:
+        with self._lock:
+            self.reply_timeouts += 1
+
     def record_batch(self, rows: int, forward_ms: float) -> None:
         with self._lock:
             self.batch_rows.observe(float(rows))
@@ -130,6 +135,7 @@ class InferenceTelemetry:
                 "inference/requests": float(self.requests),
                 "inference/sheds": float(self.sheds),
                 "inference/wire_errors": float(self.wire_errors),
+                "inference/reply_timeouts": float(self.reply_timeouts),
             }
             out.update(self.latency_ms.summary("inference/latency_ms"))
             out.update(self.batch_rows.summary("inference/batch_rows"))
@@ -264,7 +270,7 @@ class InferenceServer:
             except OSError:
                 return  # socket closed
             threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+                             name="infer-serve", daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -374,7 +380,19 @@ class InferenceServer:
                     self.telemetry.record_shed()
                     return {"shed": True, "retry_after_ms": 1000,
                             "credits": self.flow.grant(actor_id)}
-                p.event.wait()  # in-flight: the forward owns it now
+                # in-flight: the forward owns it and sets the event on
+                # success AND error paths, so this normally returns in
+                # one batch time. The bound guards the one remaining
+                # hang — a batcher wedged mid-forward (device stall)
+                # would strand this reply forever, and with it the
+                # client's connection mutex. Timing out is counted and
+                # surfaced as a plain error; the client reconnects and
+                # re-sends, which is safe because infer is idempotent
+                if not p.event.wait(2 * REPLY_BOUND_S):
+                    self.telemetry.record_reply_timeout()
+                    return {"error": "inference reply timed out in-flight"
+                                     f" ({2 * REPLY_BOUND_S:.0f}s) — "
+                                     "batcher wedged"}
         if p.error is not None:
             return {"error": p.error}
         resp: dict[str, Any] = {

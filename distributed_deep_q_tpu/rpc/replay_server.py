@@ -663,7 +663,7 @@ class ReplayFeedServer:
             except OSError:
                 return  # socket closed
             threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+                             name="replayfeed-serve", daemon=True).start()
 
     def _log_error(self, what: str, e: BaseException) -> None:
         """Rate-limited error logging: one line per ERR_LOG_PERIOD with a
@@ -1077,7 +1077,11 @@ class ReplayFeedClient:
             self._connect()
 
     def _connect(self) -> None:
-        sock = socket.create_connection(self._addr, timeout=self._timeout)
+        # the conn mutex (self._lock) is HELD here by design: its whole
+        # purpose is to serialize connect/request/reply on one socket —
+        # no other state shares it, so nothing hot can queue behind it
+        sock = socket.create_connection(  # ddq: allow(blocking.under-lock)
+            self._addr, timeout=self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = faultinject.wrap(sock, side="client")
 
@@ -1103,9 +1107,12 @@ class ReplayFeedClient:
                 tracing.instant("reconnect", method=method)
                 self._connect()
             try:
-                send_msg(self._sock, {"method": method,
-                                      "actor_id": self.actor_id, **kwargs})
-                return recv_msg(self._sock)
+                # wire I/O under the conn mutex is the mutex's job: one
+                # request/reply in flight per socket (see _connect)
+                send_msg(  # ddq: allow(blocking.under-lock) — conn mutex
+                    self._sock, {"method": method,
+                                 "actor_id": self.actor_id, **kwargs})
+                return recv_msg(self._sock)  # ddq: allow(blocking.under-lock) — conn mutex
             except Exception:
                 # ANY mid-frame failure — half-sent frame, decode desync
                 # (recv_msg raises ValueError on bad kind/oversized

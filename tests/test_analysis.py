@@ -13,7 +13,8 @@ import pytest
 
 from distributed_deep_q_tpu.analysis import repo_root, run_all
 from distributed_deep_q_tpu.analysis import (
-    atomic_writes, config_keys, locks, metric_keys, protocol_drift, purity)
+    atomic_writes, blocking, config_keys, locks, metric_keys,
+    protocol_drift, purity, threads)
 from distributed_deep_q_tpu.analysis.core import Source
 
 
@@ -547,6 +548,19 @@ def test_gate_cli_fails_on_broken_invariant(tmp_path):
     # findings carry file:line
     assert any(line.split(":")[1].isdigit()
                for line in proc.stdout.splitlines() if ":" in line)
+    # --json: one parseable object per finding on stdout, verdict on
+    # stderr; --rule narrows to the protocol pass
+    import json
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "analysis_gate.py"),
+         "--root", str(tmp_path), "--json", "--rule", "protocol"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    objs = [json.loads(line) for line in proc.stdout.splitlines()]
+    assert objs and all(
+        set(o) == {"rule", "path", "line", "message"} for o in objs)
+    assert all(o["rule"].startswith("protocol.") for o in objs)
+    assert "FAILED" in proc.stderr
 
 
 def test_chaos_smoke_preflight_passes_on_clean_tree():
@@ -556,3 +570,426 @@ def test_chaos_smoke_preflight_passes_on_clean_tree():
         chaos_smoke._require_clean_gate()  # must not SystemExit
     finally:
         sys.path.pop(0)
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle registry
+# ---------------------------------------------------------------------------
+
+THREAD_REG = threads.ThreadRegistry(
+    specs={
+        ("mod.py", "_run"): threads.ThreadSpec(
+            name="worker", owner="W", stop=("event", "_stop"),
+            joined_in="close"),
+    },
+    files=("mod.py",),
+)
+
+GOOD_THREAD_SRC = """
+    import threading
+
+    class W:
+        def __init__(self):
+            self._stop = threading.Event()
+            self._t = threading.Thread(
+                target=self._run, name="worker", daemon=True)
+            self._t.start()
+
+        def _run(self):
+            while not self._stop.wait(0.1):
+                pass
+
+        def close(self):
+            self._stop.set()
+            self._t.join()
+"""
+
+
+def test_threads_registered_lifecycle_clean():
+    findings = threads.check_sources(
+        [src(GOOD_THREAD_SRC, "mod.py")], THREAD_REG)
+    assert findings == []
+
+
+def test_threads_unregistered_spawn_caught():
+    findings = threads.check_sources([src("""
+        import threading
+
+        class W:
+            def go(self):
+                threading.Thread(target=self._other, daemon=True).start()
+    """, "mod.py")], THREAD_REG)
+    assert rules(findings) == {threads.RULE_UNREGISTERED}
+    assert "_other" in findings[0].message
+
+
+def test_threads_name_mismatch_and_missing_join_caught():
+    findings = threads.check_sources([src("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._stop = threading.Event()
+                self._t = threading.Thread(
+                    target=self._run, name="wrong-name", daemon=True)
+
+            def _run(self):
+                pass
+
+            def close(self):
+                self._stop.set()  # no join on self._t
+    """, "mod.py")], THREAD_REG)
+    assert rules(findings) == {threads.RULE_MISMATCH, threads.RULE_NO_JOIN}
+
+
+def test_threads_unset_stop_event_caught():
+    """A stop event nobody ever .set()s is an unstoppable thread."""
+    findings = threads.check_sources([src("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._stop = threading.Event()
+                self._t = threading.Thread(
+                    target=self._run, name="worker", daemon=True)
+
+            def _run(self):
+                while not self._stop.wait(0.1):
+                    pass
+
+            def close(self):
+                self._t.join()
+    """, "mod.py")], THREAD_REG)
+    assert rules(findings) == {threads.RULE_NO_STOP}
+
+
+FLAG_REG = threads.ThreadRegistry(
+    specs={
+        ("mod.py", "_run"): threads.ThreadSpec(
+            name="drain", owner="D", stop=("flag", "_closed", "_cv"),
+            joined_in="close"),
+    },
+    files=("mod.py",),
+)
+
+FLAG_SRC = """
+    import threading
+
+    class D:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self._closed = False
+            self._t = threading.Thread(
+                target=self._run, name="drain", daemon=True)
+
+        def _run(self):
+            with self._cv:
+                while not self._closed:
+                    self._cv.wait()
+
+        def close(self):
+            {shutdown}
+            self._t.join()
+"""
+
+
+def test_threads_stop_flag_write_outside_guard_caught():
+    findings = threads.check_sources([src(
+        FLAG_SRC.format(shutdown="self._closed = True"), "mod.py")],
+        FLAG_REG)
+    assert rules(findings) == {threads.RULE_STOP_UNGUARDED}
+    # the __init__ seed write is exempt (single-threaded construction)
+    assert len(findings) == 1
+
+
+def test_threads_stop_flag_write_under_guard_clean():
+    shutdown = ("with self._cv:\n"
+                "                self._closed = True\n"
+                "                self._cv.notify_all()")
+    findings = threads.check_sources([src(
+        FLAG_SRC.format(shutdown=shutdown), "mod.py")], FLAG_REG)
+    assert findings == []
+
+
+def test_threads_daemon_without_join_needs_reason():
+    reg = threads.ThreadRegistry(
+        specs={
+            ("mod.py", "_run"): threads.ThreadSpec(
+                name="w", owner="W", stop=("event", "_stop"),
+                joined_in=None),  # no why_no_join rationale
+        },
+        files=("mod.py",),
+    )
+    findings = threads.check_sources([src("""
+        import threading
+
+        class W:
+            def go(self):
+                self._t = threading.Thread(
+                    target=self._run, name="w", daemon=True)
+
+            def _run(self):
+                pass
+
+            def close(self):
+                self._stop.set()
+    """, "mod.py")], reg)
+    assert rules(findings) == {threads.RULE_NO_JOIN}
+    assert "why_no_join" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# blocking-while-locked
+# ---------------------------------------------------------------------------
+
+BLOCK_LOCKS = {"replay_lock", "_cv"}
+
+
+def blocking_findings(text: str, path: str = "mod.py"):
+    return blocking.check_sources([src(text, path)],
+                                  lock_names=BLOCK_LOCKS,
+                                  unlocked=frozenset({"__init__"}))
+
+
+def test_blocking_sleep_under_lock_caught():
+    findings = blocking_findings("""
+        import time
+
+        class S:
+            def flush(self):
+                with self.replay_lock:
+                    time.sleep(0.1)
+    """)
+    assert rules(findings) == {blocking.RULE}
+    assert "time.sleep()" in findings[0].message
+
+
+def test_blocking_off_lock_clean():
+    findings = blocking_findings("""
+        import time
+
+        class S:
+            def flush(self):
+                with self.replay_lock:
+                    rows = self.pop()
+                time.sleep(0.1)
+    """)
+    assert findings == []
+
+
+def test_blocking_interprocedural_callee_expansion():
+    """The fsync lives two calls away from the lock: the finding lands
+    on the blocking line, with the lock-entry site in the message."""
+    findings = blocking_findings("""
+        import os
+
+        class S:
+            def snapshot(self):
+                with self.replay_lock:
+                    self._persist()
+
+            def _persist(self):
+                self._sync()
+
+            def _sync(self):
+                os.fsync(self.fd)
+    """)
+    assert rules(findings) == {blocking.RULE}
+    [f] = findings
+    assert "os.fsync()" in f.message and "entered from mod.py:" in f.message
+
+
+def test_blocking_cv_wait_on_held_lock_exempt_foreign_wait_caught():
+    """Condition.wait on the HELD condition releases it (not blocking-
+    under-lock); waiting on a foreign event under the lock is."""
+    findings = blocking_findings("""
+        class S:
+            def take(self):
+                with self._cv:
+                    while not self.ready:
+                        self._cv.wait()
+
+            def bad(self):
+                with self._cv:
+                    self.other_event.wait()
+    """)
+    assert [f.rule for f in findings] == [blocking.RULE]
+    assert "foreign event" in findings[0].message
+
+
+def test_blocking_pragma_suppresses():
+    findings = blocking_findings("""
+        class C:
+            def call(self):
+                with self.replay_lock:
+                    return recv_msg(self.sock)  # ddq: allow(blocking.under-lock)
+    """)
+    assert findings == []
+
+
+def test_blocking_init_is_not_a_lock_root():
+    findings = blocking_findings("""
+        import time
+
+        class S:
+            def __init__(self):
+                with self.replay_lock:
+                    time.sleep(0.1)
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# condition-variable discipline
+# ---------------------------------------------------------------------------
+
+CV_REG = locks.LockRegistry(
+    attrs={}, globals={}, conditions=frozenset({"_cv"}))
+
+
+def test_cv_wait_without_while_caught():
+    findings = locks.check_sources([src("""
+        class S:
+            def take(self):
+                with self._cv:
+                    if not self.ready:
+                        self._cv.wait()
+    """)], CV_REG)
+    assert rules(findings) == {locks.RULE_CV_WAIT}
+
+
+def test_cv_wait_in_while_and_wait_for_clean():
+    findings = locks.check_sources([src("""
+        class S:
+            def take(self):
+                with self._cv:
+                    while not self.ready:
+                        self._cv.wait()
+
+            def take2(self):
+                with self._cv:
+                    self._cv.wait_for(lambda: self.ready)
+    """)], CV_REG)
+    assert findings == []
+
+
+def test_cv_notify_without_lock_caught():
+    findings = locks.check_sources([src("""
+        class S:
+            def put(self, row):
+                self.rows.append(row)
+                self._cv.notify_all()
+    """)], CV_REG)
+    assert rules(findings) == {locks.RULE_CV_NOTIFY}
+
+
+def test_cv_notify_under_lock_clean():
+    findings = locks.check_sources([src("""
+        class S:
+            def put(self, row):
+                with self._cv:
+                    self.rows.append(row)
+                    self._cv.notify_all()
+    """)], CV_REG)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# wire-verb idempotence classes
+# ---------------------------------------------------------------------------
+
+PROTO_OK = """
+    _KIND_A = 0
+
+    def encode(m):
+        return _KIND_A
+
+    def _decode(p):
+        return _KIND_A
+"""
+
+
+def test_protocol_unclassified_and_stale_verb_caught():
+    findings = protocol_drift.check_sources(
+        src(SERVER_SRC, "server.py"), src(PROTO_OK, "proto.py"),
+        [src("""
+            def go(c):
+                c.call("ping")
+                c.call_once("orphaned")
+        """, "client.py")],
+        verb_classes={"ping": protocol_drift.IDEMPOTENT,
+                      "gone": protocol_drift.DEDUP_KEYED})
+    by_rule = {f.rule: f for f in findings}
+    assert set(by_rule) == {"protocol.unclassified-verb",
+                            "protocol.stale-verb-class"}
+    assert "orphaned" in by_rule["protocol.unclassified-verb"].message
+    assert "gone" in by_rule["protocol.stale-verb-class"].message
+
+
+def test_protocol_unsafe_verb_on_retry_path_caught():
+    """.call() retries on failure — an unsafe verb must not ride it;
+    call_once (single attempt) is the sanctioned escape hatch."""
+    findings = protocol_drift.check_sources(
+        src(SERVER_SRC, "server.py"), src(PROTO_OK, "proto.py"),
+        [src("""
+            def go(c):
+                c.call("ping")
+                c.call_once("orphaned")
+        """, "client.py")],
+        verb_classes={"ping": protocol_drift.UNSAFE,
+                      "orphaned": protocol_drift.UNSAFE})
+    assert rules(findings) == {"protocol.unsafe-resend"}
+    [f] = findings
+    assert "'ping'" in f.message and f.path == "client.py"
+
+
+def test_protocol_every_real_verb_is_classified():
+    """Every verb in the live VERB_CLASSES table names a known class —
+    the table itself cannot drift to a typo'd class name."""
+    valid = {protocol_drift.IDEMPOTENT, protocol_drift.DEDUP_KEYED,
+             protocol_drift.UNSAFE}
+    assert protocol_drift.VERB_CLASSES
+    assert set(protocol_drift.VERB_CLASSES.values()) <= valid
+
+
+# ---------------------------------------------------------------------------
+# new-pass self-host ratchets + gate CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_threads_and_blocking_self_host_zero():
+    """The live tree satisfies the thread-lifecycle and blocking
+    ratchets pass-by-pass (run_all covers the union; these keep the
+    attribution obvious when one regresses)."""
+    root = repo_root()
+    assert threads.check(root) == []
+    assert blocking.check(root) == []
+
+
+def test_gate_cli_rule_filter_json_and_list_rules():
+    gate = os.path.join(repo_root(), "scripts", "analysis_gate.py")
+    proc = subprocess.run(
+        [sys.executable, gate, "--rule", "locks", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # --json keeps stdout machine-parseable: findings only (none on a
+    # clean tree); the human verdict goes to stderr
+    assert proc.stdout.strip() == ""
+    assert "clean" in proc.stderr
+
+    proc = subprocess.run(
+        [sys.executable, gate, "--list-rules"],
+        capture_output=True, text=True, timeout=120)
+    listed = proc.stdout.split()
+    assert proc.returncode == 0
+    for rule in ("threads.unregistered", "blocking.under-lock",
+                 "locks.cv-wait-no-loop", "protocol.unsafe-resend"):
+        assert rule in listed
+
+
+def test_gate_cli_unknown_rule_prefix_exits_2():
+    gate = os.path.join(repo_root(), "scripts", "analysis_gate.py")
+    proc = subprocess.run(
+        [sys.executable, gate, "--rule", "nonsense"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "unknown rule prefix" in proc.stderr
