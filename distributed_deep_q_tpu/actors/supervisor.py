@@ -928,11 +928,21 @@ def _recurrent_actor_loop(cfg: Config, env, qnet, client, rng, eps: float,
 
 
 class ActorSupervisor:
-    """Spawns the actor fleet and restarts dead or silent actors."""
+    """Spawns the actor fleet and restarts dead or silent actors.
+
+    The fleet is ELASTIC (ISSUE 20): the autoscale executor grows and
+    retires actors at runtime through ``grow``/``retire``, so the
+    process map moves under ``_procs_lock`` — the watch loop re-checks
+    membership under it before acting, which is what keeps a concurrent
+    retirement from being "helpfully" respawned. Executor-initiated
+    terminations are counted in ``executor_terminations``, SEPARATE
+    from ``kill_escalations`` (crash-kill SIGKILL escalations), so a
+    scale-down never reads as a crash in ``telemetry_report``.
+    """
 
     def __init__(self, cfg: Config, host: str, port: int,
                  heartbeat_timeout: float = 60.0,
-                 spawn_grace: float = 120.0):
+                 spawn_grace: float = 120.0, target=None):
         self.cfg = cfg
         self.host, self.port = host, port
         self.heartbeat_timeout = heartbeat_timeout
@@ -941,26 +951,96 @@ class ActorSupervisor:
         # but finite, so an actor that hangs BEFORE its first heartbeat
         # (wedged env ctor, dead DNS) is still detected and replaced
         self.spawn_grace = max(spawn_grace, heartbeat_timeout)
+        # the child entry point: actor_main unless a harness substitutes
+        # a lightweight worker (same (cfg, host, port, i, stop) shape)
+        self._target = target or actor_main
         self._ctx = mp.get_context("spawn")
+        # parent-side master switch (watch-loop pacing). Children get a
+        # PRIVATE per-incarnation event instead: a child terminated
+        # while parked in mp.Event.wait() dies still registered as a
+        # sleeper on the event's shared Condition, and the next set()
+        # on that event deadlocks (see _RemoteInference._local_stop).
+        # With the executor retiring HEALTHY actors — which are usually
+        # parked in wait() — a shared event would wedge stop() almost
+        # every scale-down; a private one is orphaned harmlessly.
         self.stop_event = self._ctx.Event()
+        self._procs_lock = threading.RLock()
+        self._child_stops: dict[int, Any] = {}
         self.procs: dict[int, Any] = {}
         self.spawned_at: dict[int, float] = {}
+        self.retired: set[int] = set()
         self.restarts = 0
         self.kill_escalations = 0
+        self.executor_terminations = 0
         self._watch: threading.Thread | None = None
 
     def _spawn(self, i: int) -> None:
+        ev = self._ctx.Event()
         p = self._ctx.Process(
-            target=actor_main,
-            args=(self.cfg, self.host, self.port, i, self.stop_event),
+            target=self._target,
+            args=(self.cfg, self.host, self.port, i, ev),
             name=f"actor-{i}", daemon=True)
         p.start()
-        self.procs[i] = p
-        self.spawned_at[i] = time.monotonic()
+        with self._procs_lock:
+            self.procs[i] = p
+            self._child_stops[i] = ev
+            self.spawned_at[i] = time.monotonic()
 
     def start(self) -> None:
         for i in range(self.cfg.actors.num_actors):
             self._spawn(i)
+
+    # -- elastic surface (the autoscale executor's verbs) --------------------
+
+    def fleet_size(self) -> int:
+        with self._procs_lock:
+            return len(self.procs)
+
+    def actor_ids(self) -> list[int]:
+        with self._procs_lock:
+            return sorted(self.procs)
+
+    def grow(self) -> int:
+        """Start one more actor: reuse the lowest retired slot (its
+        replay stream was evicted, the id is clean) else mint the next
+        id. Returns the actor id."""
+        with self._procs_lock:
+            if self.retired:
+                i = min(self.retired)
+                self.retired.discard(i)
+            else:
+                i = max(self.procs) + 1 if self.procs else 0
+        self._spawn(i)
+        return i
+
+    def retire(self, i: int) -> bool:
+        """Executor-initiated scale-down of one actor: remove it from
+        the supervised map FIRST (so the watch loop cannot respawn it),
+        then terminate. Counted separately from crash-kills."""
+        with self._procs_lock:
+            p = self.procs.pop(i, None)
+            self.spawned_at.pop(i, None)
+            ev = self._child_stops.pop(i, None)
+            if p is not None:
+                self.retired.add(i)
+        if p is None:
+            return False
+        # polite first: signal the child's private stop event and give
+        # it a moment to exit its loop — a drained, healthy actor then
+        # leaves without ever seeing SIGTERM. _reap is a no-op on an
+        # already-exited process, the escalation ladder otherwise.
+        if ev is not None:
+            ev.set()
+            p.join(timeout=2)
+        self._reap(p)
+        with self._procs_lock:
+            self.executor_terminations += 1
+        return True
+
+    def reap_actor(self, i: int) -> bool:
+        """Rollback path: reap a just-grown actor that missed its grace
+        window and release its slot for the next grow."""
+        return self.retire(i)
 
     def _is_silent(self, now: float, last: float, spawned: float) -> bool:
         """Liveness verdict for one actor. Contact since the last
@@ -983,7 +1063,8 @@ class ActorSupervisor:
         if p.is_alive():
             p.kill()
             p.join(timeout=5)
-            self.kill_escalations += 1
+            with self._procs_lock:
+                self.kill_escalations += 1
 
     def watch(self, last_seen: dict[int, float],
               poll_period: float = 2.0) -> None:
@@ -992,14 +1073,20 @@ class ActorSupervisor:
         def loop() -> None:
             while not self.stop_event.is_set():
                 now = time.monotonic()
-                for i, p in list(self.procs.items()):
+                with self._procs_lock:
+                    snap = list(self.procs.items())
+                    spawned = dict(self.spawned_at)
+                for i, p in snap:
                     dead = not p.is_alive()
                     silent = self._is_silent(
                         now, last_seen.get(_liveness_id(self.cfg, i), 0.0),
-                        self.spawned_at.get(i, 0.0))
+                        spawned.get(i, 0.0))
                     if dead or silent:
+                        with self._procs_lock:
+                            if self.procs.get(i) is not p:
+                                continue  # retired/replaced concurrently
+                            self.restarts += 1
                         self._reap(p)
-                        self.restarts += 1
                         self._spawn(i)
                 time.sleep(poll_period)
 
@@ -1009,7 +1096,15 @@ class ActorSupervisor:
 
     def stop(self, timeout: float = 10.0) -> None:
         self.stop_event.set()
-        for p in self.procs.values():
+        with self._procs_lock:
+            procs = list(self.procs.values())
+            events = list(self._child_stops.values())
+        # only live, supervised children share these events (a retired
+        # or respawned incarnation's event was popped with it), so set()
+        # here cannot trip the dead-sleeper deadlock
+        for ev in events:
+            ev.set()
+        for p in procs:
             p.join(timeout=timeout)
             if p.is_alive():
                 self._reap(p)
@@ -1061,7 +1156,11 @@ def _bring_up_rpc_plane(cfg: Config, replay, obs_dim: int = 4):
             cutoff_us=cfg.inference.cutoff_us,
             flow=FlowConfig(
                 staged_high_watermark=cfg.inference.queue_high_watermark,
-                shed_policy=cfg.replay.shed_policy))
+                shed_policy=cfg.replay.shed_policy),
+            tenants=cfg.inference.tenants,
+            shed_shadow_frac=cfg.inference.shed_shadow_frac,
+            shed_ab_frac=cfg.inference.shed_ab_frac,
+            ladder_burn_s=cfg.inference.ladder_burn_s)
         cfg.inference.host, cfg.inference.port = infer_server.address
     host, port = server.address
     # elastic-fleet registry (ISSUE 17): the learner host seeds the
@@ -1117,25 +1216,51 @@ def _bring_up_health_plane(cfg: Config, server, infer_server=None,
     return fleet, MFUMeter(flops, peak)
 
 
-def _bring_up_autoscaler(cfg: Config):
-    """Health-driven autoscaler (ISSUE 17) — ``None`` unless BOTH the
-    health plane and ``cfg.autoscale`` are enabled; its only input is
-    the fleet verdict, so without scrapes it could only ever no-op."""
+def _bring_up_autoscaler(cfg: Config, sup=None, server=None):
+    """Health-driven autoscaler (ISSUE 17) + its executor (ISSUE 20).
+
+    Returns ``(autoscaler, executor)`` — ``(None, None)`` unless BOTH
+    the health plane and ``cfg.autoscale`` are enabled (the scaler's
+    only input is the fleet verdict, so without scrapes it could only
+    ever no-op). The executor additionally needs ``autoscale.execute``
+    plus a supervisor to drive; it drains/evicts through the replay
+    server and checks spawn-grace heartbeats against its contact map."""
     if not (health.ENABLED and cfg.autoscale.enabled):
-        return None
+        return None, None
     from distributed_deep_q_tpu.actors.autoscaler import Autoscaler
     a = cfg.autoscale
     boot = cfg.actors.fleet_size or cfg.actors.num_actors
-    return Autoscaler(
+    scaler = Autoscaler(
         min_actors=min(a.min_actors, boot),
         max_actors=a.max_actors or boot,
         min_inference=a.min_inference, max_inference=a.max_inference,
         step=a.step, cooldown_s=a.cooldown_s,
         recover_ticks=a.recover_ticks)
+    executor = None
+    if a.execute and sup is not None:
+        from distributed_deep_q_tpu.actors.executor import ScaleExecutor
+        hb = None
+        seq = None
+        evict = None
+        if server is not None:
+            spawned = sup.spawned_at
+
+            def hb(i: int) -> bool:  # noqa: E306 — grace-window check
+                return (server.last_seen.get(_liveness_id(cfg, i), 0.0)
+                        > spawned.get(i, 0.0))
+
+            seq = server.stream_seq_of
+            evict = server.retire_stream
+        executor = ScaleExecutor(
+            sup, rate_limit_s=a.rate_limit_s, drain_s=a.drain_s,
+            spawn_grace_s=a.spawn_grace_s, dry_run=a.dry_run,
+            heartbeat_ok=hb, stream_seq=seq, retire_stream=evict)
+    return scaler, executor
 
 
 def _health_tick(fleet, meter, server, gstep: int,
-                 scrape: bool = True, autoscaler=None) -> dict:
+                 scrape: bool = True, autoscaler=None,
+                 executor=None) -> dict:
     """Per-log-tick health/efficiency record: live MFU + ingest
     utilization gauges, fleet self-accounting, and the aggregated
     verdict (a JSON-able dict — ``Metrics.log`` passes non-numerics
@@ -1144,7 +1269,11 @@ def _health_tick(fleet, meter, server, gstep: int,
     With an autoscaler attached, each FRESH scrape is folded through it
     (stale ``last()`` verdicts would double-count into the recovery
     streak) and any decisions ride the same record under
-    ``autoscale/decision`` — rule + burn numbers, lineage-traceable."""
+    ``autoscale/decision`` — rule + burn numbers, lineage-traceable.
+    With an EXECUTOR attached (ISSUE 20), the tick's decisions are
+    applied synchronously on this thread and every action taken lands
+    under ``autoscale/applied`` naming the decision's rule — applied
+    vs target is what ``telemetry_report --strict`` audits."""
     if not health.ENABLED:
         return {}
     fc = server.flow_counters()
@@ -1160,6 +1289,11 @@ def _health_tick(fleet, meter, server, gstep: int,
         if decisions:
             out["autoscale/decision"] = [d.to_jsonable()
                                          for d in decisions]
+        if executor is not None:
+            applied = executor.apply(decisions)
+            out.update(executor.gauges())
+            if applied:
+                out["autoscale/applied"] = applied
     out["health/verdict"] = v.to_jsonable()
     return out
 
@@ -1274,7 +1408,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     fleet_health, mfu_meter = _bring_up_health_plane(
         cfg, server, infer_server, solver=solver, replay=replay,
         fused=fused_per)
-    autoscaler = _bring_up_autoscaler(cfg)
+    autoscaler, scale_executor = _bring_up_autoscaler(cfg, sup, server)
     writeback = None
     if replay.prioritized and not fused_per:
         from distributed_deep_q_tpu.replay.prioritized import make_writeback
@@ -1424,6 +1558,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                     "grad_steps_per_s": metrics.rate("grad_steps"),
                     "actor_restarts": sup.restarts,
                     "actor_kill_escalations": sup.kill_escalations,
+                    "actor_scale_terminations": sup.executor_terminations,
                 }
                 # one record carries the whole telemetry spine: per-phase
                 # times, per-RPC-method latency/size percentiles, queue
@@ -1448,7 +1583,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
                     fleet_health, mfu_meter, server, gstep,
                     scrape=(gstep // log_every)
                     % max(cfg.health.scrape_every, 1) == 0,
-                    autoscaler=autoscaler)
+                    autoscaler=autoscaler, executor=scale_executor)
                 metrics.log(gstep, **summary, **timer.summary(),
                             **server.telemetry_summary(), **infer_tm,
                             **metrics.telemetry(), **hk)
@@ -1468,6 +1603,7 @@ def train_distributed(cfg: Config, metrics: Metrics | None = None,
     summary["env_steps"] = server.counters()["env_steps"]
     summary["actor_restarts"] = sup.restarts
     summary["actor_kill_escalations"] = sup.kill_escalations
+    summary["actor_scale_terminations"] = sup.executor_terminations
     rpc = server.telemetry.robustness_counters()
     summary["rpc_dispatch_errors"] = rpc["dispatch_errors"]
     summary["rpc_duplicate_flushes"] = rpc["duplicate_flushes"]
@@ -1579,7 +1715,7 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
     # recurrent state — the transition-path census doesn't apply), so
     # live MFU is absent here; steps/s + ingest utilization still emit
     fleet_health, mfu_meter = _bring_up_health_plane(cfg, server)
-    autoscaler = _bring_up_autoscaler(cfg)
+    autoscaler, scale_executor = _bring_up_autoscaler(cfg, sup, server)
     writeback = None
     if replay.prioritized and not fused_seq:
         from distributed_deep_q_tpu.replay.prioritized import make_writeback
@@ -1661,12 +1797,13 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
                     "grad_steps_per_s": metrics.rate("grad_steps"),
                     "actor_restarts": sup.restarts,
                     "actor_kill_escalations": sup.kill_escalations,
+                    "actor_scale_terminations": sup.executor_terminations,
                 }
                 hk = _health_tick(
                     fleet_health, mfu_meter, server, gstep,
                     scrape=(gstep // log_every)
                     % max(cfg.health.scrape_every, 1) == 0,
-                    autoscaler=autoscaler)
+                    autoscaler=autoscaler, executor=scale_executor)
                 metrics.log(gstep, **summary, **timer.summary(),
                             **server.telemetry_summary(),
                             **metrics.telemetry(), **hk)
@@ -1683,6 +1820,7 @@ def _train_distributed_recurrent(cfg: Config, metrics: Metrics | None = None,
     summary["env_steps"] = server.counters()["env_steps"]
     summary["actor_restarts"] = sup.restarts
     summary["actor_kill_escalations"] = sup.kill_escalations
+    summary["actor_scale_terminations"] = sup.executor_terminations
     rpc = server.telemetry.robustness_counters()
     summary["rpc_dispatch_errors"] = rpc["dispatch_errors"]
     summary["rpc_duplicate_flushes"] = rpc["duplicate_flushes"]

@@ -176,6 +176,19 @@ DEFAULT_REGISTRY = LockRegistry(
         "_pending":         Guard("_cv", "InferenceServer"),
         "_queued_rows":     Guard("_cv", "InferenceServer"),
         "_closed":          Guard("_cv", "InferenceServer"),
+        # degrade ladder (ISSUE 20): level + hysteresis stamps + the
+        # first-shed ledger move under the SAME condition as the row
+        # gauge the occupancy is computed from
+        "_ladder_level":    Guard("_cv", "InferenceServer"),
+        "_ladder_rise_since": Guard("_cv", "InferenceServer"),
+        "_ladder_fall_since": Guard("_cv", "InferenceServer"),
+        "_ladder_ledger":   Guard("_cv", "InferenceServer"),
+        "_first_shed":      Guard("_cv", "InferenceServer"),
+        # tenant registry (ISSUE 20): tag → _Tenant map and the cached
+        # A/B arm tuple move with the θ installs they key (helpers
+        # re-acquire the RLock lexically)
+        "_tenants":         Guard("_params_lock", "InferenceServer"),
+        "_active_arms":     Guard("_params_lock", "InferenceServer"),
         # InferenceTelemetry: every histogram/counter is touched from
         # every serve thread plus the batcher; one lock guards them all
         "requests":         Guard("_lock", "InferenceTelemetry"),
@@ -185,6 +198,8 @@ DEFAULT_REGISTRY = LockRegistry(
         "latency_ms":       Guard("_lock", "InferenceTelemetry"),
         "batch_rows":       Guard("_lock", "InferenceTelemetry"),
         "forward_ms":       Guard("_lock", "InferenceTelemetry"),
+        "tenant_counts":    Guard("_lock", "InferenceTelemetry"),
+        "tenant_latency":   Guard("_lock", "InferenceTelemetry"),
         # HealthMonitor (ISSUE 13): rings, rule hysteresis state, prev
         # histogram snapshots, and the cached verdict are written on the
         # telemetry cadence and read from serve threads answering the
@@ -225,6 +240,20 @@ DEFAULT_REGISTRY = LockRegistry(
         "_as_ok_streak":    Guard("_as_lock", "Autoscaler"),
         "_as_last_at":      Guard("_as_lock", "Autoscaler"),
         "_as_counts":       Guard("_as_lock", "Autoscaler"),
+        # ActorSupervisor (ISSUE 20): the fleet became elastic — the
+        # process map and its counters race the watch loop against the
+        # autoscale executor's grow/retire
+        "procs":            Guard("_procs_lock", "ActorSupervisor"),
+        "spawned_at":       Guard("_procs_lock", "ActorSupervisor"),
+        "retired":          Guard("_procs_lock", "ActorSupervisor"),
+        "restarts":         Guard("_procs_lock", "ActorSupervisor"),
+        "kill_escalations": Guard("_procs_lock", "ActorSupervisor"),
+        "executor_terminations": Guard("_procs_lock", "ActorSupervisor"),
+        # ScaleExecutor (ISSUE 20): applied-action counters, the rate-
+        # limit stamp, and grows inside their grace window
+        "_ex_counts":       Guard("_ex_lock", "ScaleExecutor"),
+        "_ex_last_apply":   Guard("_ex_lock", "ScaleExecutor"),
+        "_ex_pending_grows": Guard("_ex_lock", "ScaleExecutor"),
         # NOTE deliberately unregistered: ReplayFeedServer.last_seen is a
         # GIL-atomic monotonic stamp dict (single-writer per key, reader
         # tolerates staleness); DeviceStager._err is benign once-set.
@@ -242,6 +271,7 @@ DEFAULT_REGISTRY = LockRegistry(
         "distributed_deep_q_tpu/actors/supervisor.py",
         "distributed_deep_q_tpu/actors/membership.py",
         "distributed_deep_q_tpu/actors/autoscaler.py",
+        "distributed_deep_q_tpu/actors/executor.py",
         "distributed_deep_q_tpu/health.py",
         "distributed_deep_q_tpu/learning.py",
         "distributed_deep_q_tpu/replay/staging.py",

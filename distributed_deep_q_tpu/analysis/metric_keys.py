@@ -42,7 +42,7 @@ RULE_SPAN = "metric_keys.unknown-span"
 
 NAMESPACES = ("rpc", "fleet", "queue", "durability", "flow", "trace",
               "learner", "ingest", "inference", "shard", "actor",
-              "health", "train", "learn", "autoscale")
+              "health", "train", "learn", "autoscale", "tenant")
 _NS_RE = re.compile(r"^(?:%s)/.+" % "|".join(NAMESPACES))
 
 EMITTERS = frozenset(
@@ -187,6 +187,32 @@ REGISTRY = frozenset({
     "autoscale/cooldown_blocked",
     "autoscale/target_actors",
     "autoscale/target_inference",
+    # autoscale executor (ISSUE 20): the applied-action record (a JSON
+    # list next to autoscale/decision) + the executor's self-accounting
+    # gauges the strict report audits against the scaler's targets
+    "autoscale/applied",
+    "autoscale/applied_actors",
+    "autoscale/applied_actions",
+    "autoscale/rollbacks",
+    "autoscale/retirements",
+    "autoscale/rate_limited",
+    "autoscale/skipped",
+    # multi-tenant inference plane (ISSUE 20): per-tag keys are dynamic
+    # (f"tenant/{tag}/...", unchecked); the fleet aggregates, the
+    # ladder gauges, and the fnmatch PATTERNS the tenant SLO rules
+    # watch are the literal surface
+    "tenant/requests",
+    "tenant/sheds",
+    "tenant/shadow_requests",
+    "tenant/shadow_diverged",
+    "tenant/swaps",
+    "tenant/served",
+    "tenant/ladder_level",
+    "tenant/shed_shadow",
+    "tenant/shed_ab",
+    "tenant/shed_primary",
+    "tenant/*/latency_ms_p99",
+    "tenant/*/sheds",
 })
 
 _TRACING_REL = os.path.join("distributed_deep_q_tpu", "tracing.py")

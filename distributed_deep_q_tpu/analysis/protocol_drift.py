@@ -90,6 +90,9 @@ VERB_CLASSES = {
     # seal current slot + drop the actor's flush_seq stamp; re-sealing
     # an already-sealed slot and re-popping an absent stamp are no-ops
     "reset_stream": IDEMPOTENT,
+    # permanent dedup-stamp eviction on scale-down (ISSUE 20): evicting
+    # an absent stamp is the same no-op twice — safe to re-send
+    "retire_stream": IDEMPOTENT,
     # membership state converges: re-join supersedes the member row,
     # leaving an absent member is a pop of nothing, a lease renew
     # extends monotonically from `now`. Each duplicate delivery still

@@ -379,9 +379,13 @@ class AutoscaleConfig:
     folds each scraped verdict through the autoscaler on the health
     tick; decisions land in the run JSONL under ``autoscale/decision``
     with the triggering rule and burn numbers, and the targets are
-    exported as ``autoscale/target_*`` gauges. The scaler only decides;
-    acting on a decision is the operator's (or the churn harness's)
-    job.
+    exported as ``autoscale/target_*`` gauges. With ``execute`` on, a
+    supervisor-side ``ScaleExecutor`` (``actors/executor.py``) closes
+    the loop: actor-dimension decisions actually start/stop actor
+    processes — rate-limited, dry-run-able, rolled back when a spawned
+    actor misses its grace window — and every applied action lands in
+    the JSONL under ``autoscale/applied`` with the decision's rule for
+    lineage (``telemetry_report --strict`` audits applied vs target).
     """
 
     enabled: bool = False
@@ -397,6 +401,18 @@ class AutoscaleConfig:
     cooldown_s: float = 30.0
     # consecutive ok verdicts required before growing back (hysteresis)
     recover_ticks: int = 3
+    # executor (ISSUE 20): act on actor-dimension decisions. dry_run
+    # logs what WOULD happen without touching processes
+    execute: bool = False
+    dry_run: bool = False
+    # floor between applied actions (on top of the decision cooldown)
+    rate_limit_s: float = 5.0
+    # graceful retirement: wait this long for the actor's in-flight
+    # flush to drain before terminating it
+    drain_s: float = 5.0
+    # a grown actor must heartbeat within this window or the grow is
+    # rolled back (the process reaped, the slot released)
+    spawn_grace_s: float = 20.0
 
 
 @dataclass
@@ -434,6 +450,18 @@ class InferenceConfig:
     queue_high_watermark: int = 4096
     # reply-latency SLO for bench/chaos verdicts (not enforced inline)
     slo_ms: float = 50.0
+    # multi-tenant serving (ISSUE 20): extra tenant tags registered at
+    # boot ("ab:<name>" arms join the actor-hash split once θ installs;
+    # "shadow:<name>" tenants mirror primary traffic, replies never
+    # reach actors). The primary always exists and needs no entry
+    tenants: tuple = ()
+    # degrade ladder: tenant classes shed in strict order (shadow → A/B
+    # → primary) when queue occupancy SUSTAINS above these fractions of
+    # queue_high_watermark for ladder_burn_s; the primary only ever
+    # sheds through its own controller at the full watermark
+    shed_shadow_frac: float = 0.5
+    shed_ab_frac: float = 0.75
+    ladder_burn_s: float = 1.0
 
 
 @dataclass

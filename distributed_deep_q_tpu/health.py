@@ -131,11 +131,14 @@ class TrendRule:
     ``kind``: ``monotonic_growth`` — the window never decreases and
     grows by ≥ ``ratio``× overall (queue that only fills is a leak even
     before any absolute threshold trips); ``drift`` — the latest sample
-    exceeds ``ratio``× the window median (p99 creep); ``collapse`` —
-    the latest sample falls below ``ratio``× the window median while
-    the median itself sat above ``floor`` (an ingest rate that was
-    genuinely flowing and then died — the floor keeps an idle series
-    from "collapsing" from zero to zero).
+    exceeds ``ratio``× the window median AND sits above ``floor``
+    (p99 creep; the floor keeps the multiplicative noise of a fast
+    series — a windowed p99 jumping 0.2→1 ms between scrapes — from
+    reading as drift); ``collapse`` — the latest sample falls below
+    ``ratio``× the window median while the median itself sat above
+    ``floor`` (an ingest rate that was genuinely flowing and then
+    died — there the floor keeps an idle series from "collapsing"
+    from zero to zero).
     """
 
     name: str
@@ -325,7 +328,12 @@ def _trend_hit(items: list, rule: TrendRule, now: float,
         return (mono and last > base and grew, last, base)
     med = _median(vals[:-1])
     if rule.kind == "drift":
-        return (med > 0 and last > rule.ratio * med, last, med)
+        # the floor gates materiality: windowed p99s of a fast series
+        # fluctuate multiplicatively (a scrape window holds only a
+        # handful of samples), so a ratio alone fires on quantization
+        # noise — creep counts once the level itself matters
+        return (med > 0 and last > rule.floor
+                and last > rule.ratio * med, last, med)
     # collapse: was genuinely flowing (median above floor), now dead
     return (med > rule.floor and last < rule.ratio * med, last, med)
 
@@ -385,8 +393,16 @@ class HealthMonitor:
         if t is None:
             t = time.monotonic()
         with self._lock:
+            # keys a histogram feeds are OWNED by the windowed path:
+            # pushing the cumulative gauge too would pin the ring at the
+            # since-start p99 (one bad era then violates forever), and
+            # a quiet window must age out to nothing — not fall back to
+            # the cumulative value — for its rule to clear
+            owned = {name + "_p99" for name in hists} if hists else set()
             if gauges:
                 for k, v in gauges.items():
+                    if k in owned:
+                        continue
                     if isinstance(v, (int, float)) and self._watched(k):
                         self._push(k, t, float(v))
             if hists:
@@ -589,8 +605,12 @@ def default_server_trends() -> tuple:
                   kind="monotonic_growth", ratio=2.0, min_points=6),
         TrendRule(name="ingest_collapse", key="flow/ingest_rate",
                   kind="collapse", ratio=0.2, floor=1.0),
+        # floor: a tenth of the tightest latency SLO on these keys —
+        # sub-floor windowed p99s are sample-count quantization, not
+        # creep, and would otherwise flap the fleet verdict under
+        # perfectly healthy sub-millisecond traffic
         TrendRule(name="rpc_p99_drift", key="rpc/*_ms_p99",
-                  kind="drift", ratio=3.0, min_points=6),
+                  kind="drift", ratio=3.0, min_points=6, floor=25.0),
     )
 
 
@@ -599,6 +619,19 @@ def default_inference_rules() -> tuple:
         SLORule(name="infer_latency", key="inference/latency_ms_p99",
                 target=50.0, mode="above", budget=0.25),
         SLORule(name="infer_shed", key="inference/sheds",
+                target=0.0, mode="rate_above", budget=0.5),
+    )
+
+
+def default_tenant_rules() -> tuple:
+    """Per-tenant serving SLOs (ISSUE 20). Keys are fnmatch patterns
+    over the dynamic ``tenant/<tag>/*`` gauge surface, so a finding
+    NAMES the tenant that burned its budget via the matched key — the
+    chaos gate asserts the verdict JSONL carries those names."""
+    return (
+        SLORule(name="tenant_latency", key="tenant/*/latency_ms_p99",
+                target=50.0, mode="above", budget=0.25),
+        SLORule(name="tenant_shed", key="tenant/*/sheds",
                 target=0.0, mode="rate_above", budget=0.5),
     )
 

@@ -84,17 +84,23 @@ class BatchedPolicy:
 
     # -- forward ------------------------------------------------------------
 
-    def forward(self, obs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    def forward(self, obs: np.ndarray,
+                params: Any = None) -> tuple[np.ndarray, np.ndarray]:
         """Actions + Q-values for a stacked observation batch.
 
         Returns ``(actions int64 [n], q float32 [n, A])``. Rows are
         independent; padding rows are zeros and sliced off before the
         argmax, so they never influence a real row.
+
+        ``params`` overrides the installed tree for this forward only —
+        the multi-tenant inference plane serves several θ generations
+        through the SAME jitted program (θ is a traced argument, so
+        every tenant shares the compiled-bucket census).
         """
         n = obs.shape[0]
         cap = self.buckets[-1]
         if n > cap:
-            parts = [self.forward(obs[i:i + cap])
+            parts = [self.forward(obs[i:i + cap], params=params)
                      for i in range(0, n, cap)]
             return (np.concatenate([p[0] for p in parts]),
                     np.concatenate([p[1] for p in parts]))
@@ -105,7 +111,8 @@ class BatchedPolicy:
         self._compiled.add(bucket)
         self.forwards += 1
         self.rows += n
-        q = np.asarray(self._fwd(self.params, obs))[:n]
+        tree = self.params if params is None else params
+        q = np.asarray(self._fwd(tree, obs))[:n]
         # host-side argmax, same call as QNet.argmax_action — identical
         # tie-breaking keeps the remote/local action streams bitwise equal
         return np.argmax(q, axis=-1), q
@@ -119,6 +126,12 @@ class BatchedPolicy:
                 for x in jax.tree_util.tree_leaves(self.params)]
 
     def set_weights(self, flat: list[Any]) -> None:
+        self.params = self.unflatten(flat)
+
+    def unflatten(self, flat: list[Any]) -> Any:
+        """Rebuild a parameter tree from the flat RPC leaf list WITHOUT
+        installing it — tenant θ generations live outside ``params`` so
+        installing one tenant never disturbs another's forward."""
         import jax
 
-        self.params = jax.tree_util.tree_unflatten(self._treedef, list(flat))
+        return jax.tree_util.tree_unflatten(self._treedef, list(flat))

@@ -404,6 +404,25 @@ class ReplayFeedServer:
             tail = list(self.returns)[-k:]
         return float(np.mean(tail)) if tail else float("nan")
 
+    def stream_seq_of(self, actor_id: int) -> int:
+        """Highest flush_seq landed for one actor (−1 = never). The
+        autoscale executor polls this during a retirement drain — a
+        quiet seq means nothing of the actor's is mid-wire."""
+        with self.replay_lock:
+            return self._flush_seq.get(int(actor_id), -1)
+
+    def retire_stream(self, actor_id: int) -> None:
+        """Evict a permanently-retired actor's exactly-once dedup stamp
+        and contact stamp (ISSUE 20). ``reset_stream`` covers the
+        REPLACEMENT case (a fresh process reusing the id); this covers
+        scale-down, where no replacement is coming and a lingering stamp
+        is pure leak. Seals the stream's replay slot the same way."""
+        with self.replay_lock:
+            if hasattr(self.replay, "reset_stream"):
+                self.replay.reset_stream(int(actor_id))
+            self._flush_seq.pop(int(actor_id), None)
+        self.last_seen.pop(int(actor_id), None)
+
     def note_consumed(self, rows: int) -> None:
         """Learner-side feed for the credit formula: ``rows`` were sampled
         for training. Drives consumption-rate-based credits and the
@@ -788,6 +807,16 @@ class ReplayFeedServer:
             return {"ok": True}
 
         if method == "heartbeat":
+            return {"ok": True}
+
+        if method == "retire_stream":
+            # graceful scale-down (ISSUE 20): the autoscale executor has
+            # terminated this actor FOR GOOD — evict its exactly-once
+            # dedup stamp (and contact stamp) so scale-down churn cannot
+            # grow the (actor_id, flush_seq) map unboundedly. Idempotent:
+            # evicting an absent stamp is the same no-op twice
+            if actor_id >= 0:
+                self.retire_stream(actor_id)
             return {"ok": True}
 
         if method == "stream_seq":

@@ -73,6 +73,7 @@ STAGES = (
     "infer_wait",      # inference serve thread waiting on its microbatch
     "infer_batch",     # microbatch cut: stack + pad to a compiled bucket
     "infer_forward",   # the ONE device-resident jit'd policy forward
+    "infer_shadow",    # mirrored shadow-tenant forwards + drift diff
     "remote_infer",    # actor-side infer round trip (obs out, action back)
     "vector_step",     # one vectorized actor tick (N actions + batched step)
     "vector_infer",    # vector actor's batched infer round trip (one RPC)

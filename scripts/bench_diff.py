@@ -58,6 +58,12 @@ SPREAD_KEY = {
     "handoff_import_ms": "elasticity_spread",
     "remap_fraction_grow": "elasticity_spread",
     "remap_fraction_shrink": "elasticity_spread",
+    # multi-tenant serving rows (ISSUE 20) share one measured spread;
+    # shadow_overhead_pct divides two timed latencies, so its noise is
+    # the sum of their spreads — folded into the same recorded key
+    "tenant_swap_us": "tenant_spread",
+    "shadow_overhead_pct": "tenant_spread",
+    "executor_apply_us": "tenant_spread",
 }
 
 # substrings marking metrics where UP is the bad direction

@@ -113,16 +113,119 @@ def bench_remap(fleet: int) -> dict:
     }
 
 
+def bench_tenants(repeats: int) -> dict:
+    """Multi-tenant serving + executor control-path costs (ISSUE 20,
+    PERF.md §19): θ swap latency on a live server, the shadow mirror's
+    toll on primary reply latency, and the ScaleExecutor apply path
+    against an inert fleet stub (control-plane bookkeeping only — child
+    boot time is the supervisor's spawn cost, benched nowhere because
+    it is dominated by the child's jax import)."""
+    import time
+
+    from distributed_deep_q_tpu.actors.autoscaler import Decision
+    from distributed_deep_q_tpu.actors.executor import ScaleExecutor
+    from distributed_deep_q_tpu.config import NetConfig
+    from distributed_deep_q_tpu.models.policy import BatchedPolicy
+    from distributed_deep_q_tpu.rpc.inference_server import (
+        InferenceClient, InferenceServer)
+
+    net = NetConfig(kind="mlp", hidden=(32, 32), num_actions=5)
+    obs = np.random.default_rng(0).standard_normal((8, 6)).astype(np.float32)
+
+    def drive(tenants: tuple, n: int = 150) -> tuple[float, float]:
+        """-> (median primary reply ms, median set_params µs)."""
+        policy = BatchedPolicy(net, seed=0, obs_dim=6, buckets=(8,))
+        server = InferenceServer(policy, max_batch=8, cutoff_us=100,
+                                 tenants=tenants)
+        w = policy.get_weights()
+        server.set_params(w, version=1)
+        for tag in tenants:
+            server.set_params(w, version=1, tenant=tag)
+        host, port = server.address
+        client = InferenceClient(host, port, actor_id=0)
+        try:
+            for _ in range(20):  # warmup: compile + socket caches
+                client.infer(obs)
+            lat = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                client.infer(obs)
+                lat.append(1e3 * (time.perf_counter() - t0))
+            swaps = []
+            version = 2
+            for _ in range(64):
+                t0 = time.perf_counter()
+                server.set_params(w, version=version)
+                swaps.append(1e6 * (time.perf_counter() - t0))
+                version += 1
+        finally:
+            client.close()
+            server.close()
+        return statistics.median(lat), statistics.median(swaps)
+
+    class _StubFleet:
+        def __init__(self):
+            self.n = 4
+
+        def fleet_size(self):
+            return self.n
+
+        def actor_ids(self):
+            return list(range(self.n))
+
+        def grow(self):
+            self.n += 1
+            return self.n - 1
+
+        def retire(self, i):
+            self.n -= 1
+            return True
+
+        def reap_actor(self, i):
+            return self.retire(i)
+
+    plain, shadowed, swap_us, apply_us = [], [], [], []
+    for _ in range(repeats):
+        ms_plain, _ = drive(())
+        ms_shadow, sw = drive(("shadow:cand",))
+        plain.append(ms_plain)
+        shadowed.append(ms_shadow)
+        swap_us.append(sw)
+        fleet = _StubFleet()
+        ex = ScaleExecutor(fleet, rate_limit_s=0.0, drain_s=0.0)
+        t0 = time.perf_counter()
+        ex.apply([Decision("grow_actors", "capacity_recovered", "", "",
+                           1.0, 1.0, 0.0, 0.0, 4, 5, 0.0)])
+        ex.apply([Decision("shrink_actors", "ingest_shed", "k", "m",
+                           9.0, 0.0, 2.0, 1.5, 5, 4, 0.0)])
+        apply_us.append(1e6 * (time.perf_counter() - t0) / 2)
+
+    def spread(xs: list[float]) -> float:
+        m = statistics.median(xs)
+        return (max(xs) - min(xs)) / m if m else 0.0
+
+    pl, sh = statistics.median(plain), statistics.median(shadowed)
+    return {
+        "tenant_swap_us": round(statistics.median(swap_us), 1),
+        "shadow_overhead_pct": round(1e2 * (sh - pl) / pl, 2) if pl else 0.0,
+        "executor_apply_us": round(statistics.median(apply_us), 1),
+        "tenant_spread": round(max(spread(plain), spread(shadowed),
+                                   spread(swap_us)), 4),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rows", type=int, default=4096)
     ap.add_argument("--repeats", type=int, default=5)
     ap.add_argument("--fleet", type=int, default=64)
+    ap.add_argument("--tenant-repeats", type=int, default=3)
     args = ap.parse_args(argv)
     import tempfile
     with tempfile.TemporaryDirectory(prefix="bench-elasticity-") as tmp:
         out = bench_handoff(args.rows, args.repeats, tmp)
     out.update(bench_remap(args.fleet))
+    out.update(bench_tenants(args.tenant_repeats))
     print(json.dumps(out, indent=2, sort_keys=True))
     return 0
 

@@ -1,6 +1,6 @@
 """Chaos smoke — prove the RPC fault-tolerance stack end to end.
 
-Ten modes:
+Eleven modes:
 
 ``python scripts/chaos_smoke.py [num_actors] [spec]`` (default)
     Threaded actor fleet over the production wire protocol: resilient
@@ -105,6 +105,25 @@ Ten modes:
     moves) with in-flight flushes exactly-once across the handoff, and
     the labeled-frame ledger over the union of surviving shards shows
     zero lost, zero duplicated transitions and zero wrong actions.
+
+``python scripts/chaos_smoke.py tenants``
+    Closed-control-loop acceptance (ISSUE 20), two arcs on one JSONL.
+    Arc 1 — multi-tenant serving: one ``InferenceServer`` serves a
+    primary θ, an A/B arm, and a mirror-only shadow tenant to a
+    hash-split client fleet under wire chaos while a forward-latency
+    stall overloads the queue; the degrade ladder must shed strictly
+    shadow → ab → primary, shadow replies must never reach a client,
+    per-tenant SLO rules must name ``tenant/*`` findings, and every
+    reply must carry the RIGHT arm's action and θ version (per-arm
+    oracle replay: zero lost, duplicated, or wrong). Arc 2 — autoscale
+    executor: a spawned actor fleet streams labeled transitions while a
+    burst producer forces ``ingest_shed``; the health-driven autoscaler
+    must shrink, the executor must drain + retire a REAL process
+    (eviction of its exactly-once dedup stamp included, terminations
+    counted separately from kill escalations), and the recovery streak
+    must grow it back — with every applied action lineage-traceable to
+    a named Decision and ``telemetry_report``'s strict SLO + elastic
+    gates passing on the run JSONL.
 
 ``python scripts/chaos_smoke.py train [cfg.overrides ...]``
     The full distributed trainer (spawned actor processes, mesh learner)
@@ -1644,6 +1663,630 @@ def run_churn_smoke(num_actors: int = 6, flushes: int = 150, rows: int = 8,
     return verdict
 
 
+def _tenant_fleet_worker(cfg, host, port, i, stop) -> None:
+    """Spawn target for the tenants-mode actor fleet (module level so
+    the mp 'spawn' context can pickle it by name): stream labeled
+    4-row flushes through the resilient client until told to stop.
+    Column 0 carries ``f*1e3 + r`` (exact in float32 up to f≈16k —
+    packing gid into the same scalar overflows after 1000 flushes),
+    column 1 the actor gid, column 2 a per-process salt — a regrown
+    actor reusing the gid re-labels its rows, so the parent's ledger
+    can tell incarnations apart."""
+    from distributed_deep_q_tpu.rpc import faultinject
+    from distributed_deep_q_tpu.rpc.resilience import (
+        ResilientReplayFeedClient, RetryPolicy)
+
+    if cfg.actors.chaos:
+        faultinject.install(cfg.actors.chaos)
+    rows = 4
+    salt = float(os.getpid() % 65536)
+    c = ResilientReplayFeedClient.connect(
+        host, port, actor_id=i,
+        policy=RetryPolicy(base_delay=0.01, max_delay=0.2, deadline=30.0),
+        seed=300 + i)
+    f = 0
+    while not stop.is_set():
+        ids = f * 1_000 + np.arange(rows, dtype=np.float32)
+        obs = np.stack([ids, np.full(rows, float(i), np.float32),
+                        np.full(rows, salt, np.float32)], axis=1)
+        c.add_transitions(
+            obs=obs, action=np.full(rows, (i * 31 + f) % 7, np.int32),
+            reward=np.zeros(rows, np.float32), next_obs=obs,
+            discount=np.ones(rows, np.float32))
+        f += 1
+        if stop.wait(0.08):
+            break
+    c.close()
+
+
+def _wire_retry(do, mk, tries: int = 80):
+    """Land one wire call against a fresh connection per attempt —
+    under chaos a drop/truncation surfaces as a transport exception
+    here, and the verbs this harness sends this way are idempotent."""
+    last: Exception | None = None
+    for _ in range(tries):
+        c = mk()
+        try:
+            return do(c)
+        except Exception as e:  # noqa: BLE001 — chaos; retry fresh
+            last = e
+            time.sleep(0.02)
+        finally:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+    raise RuntimeError(f"wire call never landed: {last}")
+
+
+def run_tenants_smoke(deadline: float = 240.0) -> dict:
+    """Close the control loop (ISSUE 20): multi-tenant degrade ladder +
+    autoscaler executor, both against live process/wire state.
+
+    See the module docstring's ``tenants`` entry for the full gate
+    list. Both arcs write one JSONL, audited afterwards with
+    ``telemetry_report``'s strict SLO and elastic-lineage checks."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from distributed_deep_q_tpu import health
+    from distributed_deep_q_tpu.actors.autoscaler import (
+        RECOVERY_RULE, Autoscaler)
+    from distributed_deep_q_tpu.actors.executor import ScaleExecutor
+    from distributed_deep_q_tpu.actors.supervisor import ActorSupervisor
+    from distributed_deep_q_tpu.config import Config, NetConfig
+    from distributed_deep_q_tpu.metrics import Metrics
+    from distributed_deep_q_tpu.models.policy import BatchedPolicy
+    from distributed_deep_q_tpu.replay.replay_memory import ReplayMemory
+    from distributed_deep_q_tpu.rpc import faultinject
+    from distributed_deep_q_tpu.rpc.flowcontrol import FlowConfig
+    from distributed_deep_q_tpu.rpc.inference_server import (
+        TENANT_PRIMARY, InferenceClient, InferenceServer, arm_for)
+    from distributed_deep_q_tpu.rpc.replay_server import (
+        ReplayFeedClient, ReplayFeedServer)
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts"))
+    from telemetry_report import (
+        elastic_problems, load_records, slo_problems, validate_records)
+
+    health.configure(enabled=True, fast_window_s=0.5, slow_window_s=1.5,
+                     clear_ratio=0.5)
+    jsonl = tempfile.mktemp(prefix="tenants_smoke_", suffix=".jsonl")
+    metrics = Metrics(jsonl_path=jsonl)
+    trc = _trace_begin()
+    # parent-wide wire chaos: inference clients, the burst producer, and
+    # BOTH servers' accepted sockets all ride it for the whole run
+    plan = faultinject.install("drop=0.015,truncate=0.01,seed=43")
+    step = [0]
+    t0 = time.perf_counter()
+    errors: list[str] = []
+
+    # ---- arc 1: multi-tenant inference under the degrade ladder ----------
+    AB, SHADOW = "ab:cand", "shadow:next"
+    arms = (TENANT_PRIMARY, AB)
+    obs_dim, rows1, requests = 8, 8, 120
+    net = NetConfig(kind="mlp", hidden=(32, 32), num_actions=4)
+
+    class _StallPolicy(BatchedPolicy):
+        # forward-latency lever: with `stall` set every microbatch pays
+        # stall_s, so queue occupancy climbs and the ladder must walk
+        # shadow → ab → primary without any synthetic shed injection
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.stall = threading.Event()
+            self.stall_s = 0.35
+
+        def forward(self, obs, params=None):
+            if self.stall.is_set():
+                time.sleep(self.stall_s)
+            return super().forward(obs, params=params)
+
+    policy1 = _StallPolicy(net, seed=7, obs_dim=obs_dim, buckets=(8,))
+    ab_src = BatchedPolicy(net, seed=8, obs_dim=obs_dim, buckets=(8,))
+    shadow_src = BatchedPolicy(net, seed=9, obs_dim=obs_dim, buckets=(1,))
+    server1 = InferenceServer(
+        policy1, max_batch=rows1, cutoff_us=2000,
+        flow=FlowConfig(staged_high_watermark=80, ingest_factor=100.0,
+                        flush_credit_floor=8),
+        tenants=(AB, SHADOW), shed_shadow_frac=0.3, shed_ab_frac=0.55,
+        ladder_burn_s=0.2)
+    host1, port1 = server1.address
+    server1.set_params(policy1.get_weights(), version=7)
+    server1.set_params(ab_src.get_weights(), version=101, tenant=AB)
+    server1.set_params(shadow_src.get_weights(), version=201, tenant=SHADOW)
+
+    fleet1 = health.FleetHealth()
+    fleet1.register("inference", server1.health_scrape)
+    statuses1: list[str] = []
+    critical_flaps = [0]
+    tenant_slo_hits: set = set()
+
+    def tick1(collect: bool = False) -> None:
+        v = fleet1.scrape()
+        statuses1.append(v.status)
+        if v.status == "critical":
+            critical_flaps[0] += 1
+        if collect and v.status != "ok":
+            for f in v.findings:
+                if f.rule in ("tenant_shed", "tenant_latency") \
+                        and f.key.startswith("tenant/"):
+                    tenant_slo_hits.add((f.rule, f.key))
+        metrics.log(step[0], **{**fleet1.gauges(),
+                                **server1.telemetry_summary(),
+                                "health/verdict": v.to_jsonable()})
+        step[0] += 1
+        time.sleep(0.05)
+
+    def run_until1(pred, min_s: float = 0.0, max_s: float = 15.0,
+                   collect: bool = False) -> bool:
+        t1 = time.monotonic()
+        while True:
+            tick1(collect)
+            elapsed = time.monotonic() - t1
+            if elapsed >= min_s and pred():
+                return True
+            if elapsed > max_s:
+                return False
+
+    def make_obs(aid: int, i: int) -> np.ndarray:
+        # labeled: a unique deterministic batch per (client, request)
+        r = np.random.default_rng(1_000 * (aid + 1) + i)
+        return r.standard_normal((rows1, obs_dim)).astype(np.float32)
+
+    split_aids = list(range(7))        # hash split over (primary, ab)
+    pinned_aids = list(range(100, 108))  # overload wave, pinned primary
+    got: dict[int, dict] = {a: {} for a in split_aids + pinned_aids}
+    sheds1: dict[int, int] = {a: 0 for a in split_aids + pinned_aids}
+
+    def client1(aid: int, n_req: int, tenant: str = "") -> None:
+        c = None
+        try:
+            for i in range(n_req):
+                obs = make_obs(aid, i)
+                for _ in range(900):
+                    try:
+                        if c is None:
+                            c = InferenceClient(host1, port1, actor_id=aid,
+                                                timeout=5.0)
+                        resp = c.infer(obs, seq=i, tenant=tenant)
+                    except Exception:  # noqa: BLE001 — chaos; reconnect
+                        try:
+                            if c is not None:
+                                c.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                        c = None
+                        time.sleep(0.01)
+                        continue
+                    if resp.get("error"):
+                        time.sleep(0.02)
+                        continue
+                    if resp.get("shed"):
+                        sheds1[aid] += 1
+                        trc.instant("shed", plane="inference")
+                        time.sleep(min(
+                            resp.get("retry_after_ms", 10), 50) / 1e3)
+                        continue
+                    if i in got[aid]:
+                        errors.append(f"client {aid}: duplicate reply "
+                                      f"recorded for request {i}")
+                    got[aid][i] = (
+                        tuple(int(a) for a in np.asarray(resp["actions"])),
+                        int(resp.get("version", -1)),
+                        str(resp.get("tenant", "")))
+                    break
+                else:
+                    errors.append(f"client {aid}: request {i} never landed")
+                    return
+                time.sleep(0.01)
+        except Exception as e:  # noqa: BLE001 — reported in the verdict
+            errors.append(f"client {aid}: {type(e).__name__}: {e}")
+        finally:
+            try:
+                if c is not None:
+                    c.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    threads1 = [threading.Thread(target=client1, args=(a, requests),
+                                 daemon=True) for a in split_aids]
+    pinned = [threading.Thread(target=client1,
+                               args=(a, 40, TENANT_PRIMARY), daemon=True)
+              for a in pinned_aids]
+    for t in threads1:
+        t.start()
+
+    def shadow_req_count() -> float:
+        return server1.telemetry.summary().get(
+            f"tenant/{SHADOW}/shadow_requests", 0.0)
+
+    # phase 1a: healthy split traffic mirrors onto the shadow tenant
+    warmed = run_until1(lambda: shadow_req_count() > 0, max_s=15.0)
+
+    # a direct request AT the shadow tenant must be refused — its
+    # replies exist server-side only, they never reach an actor
+    def probe_shadow(c) -> dict:
+        return c.call("infer", obs=make_obs(60, 0), seq=0, tenant=SHADOW)
+
+    rej = _wire_retry(probe_shadow,
+                      lambda: InferenceClient(host1, port1, actor_id=60,
+                                              timeout=5.0), tries=200)
+    shadow_rejected = "mirror-only" in str(rej.get("error", ""))
+
+    # phase 1b: stall forwards — occupancy climbs and the ladder starts
+    # shedding at the bottom (shadow). The split load alone plateaus
+    # around the A/B fraction, so level 2 is reached by the pinned wave
+    # below; the ledger-order gate still demands shadow → ab → primary
+    policy1.stall.set()
+    lvl_up = run_until1(lambda: server1.ladder_level() >= 1, max_s=20.0,
+                        collect=True)
+    time.sleep(1.0)  # let the in-flight microbatch finish mirroring
+    s1 = shadow_req_count()
+
+    # phase 1c: a pinned-primary overload wave pushes the queue over the
+    # watermark — the PRIMARY class itself must shed, completing the
+    # strict ladder order
+    for t in pinned:
+        t.start()
+    prim_shed = run_until1(
+        lambda: any(e["class"] == "primary"
+                    for e in server1.ladder_ledger()),
+        max_s=20.0, collect=True)
+    s2 = shadow_req_count()
+
+    # phase 1d: release the stall; the fleet must walk back to ok and
+    # the ladder back to level 0 under a light primary probe
+    policy1.stall.clear()
+    for t in threads1 + pinned:
+        t.join(timeout=deadline / 2)
+    hung1 = sum(t.is_alive() for t in threads1 + pinned)
+    ladder_cleared = False
+    pc = None
+    t_end = time.monotonic() + 10.0
+    i_probe = 0
+    while time.monotonic() < t_end:
+        try:
+            if pc is None:
+                pc = InferenceClient(host1, port1, actor_id=50, timeout=5.0)
+            pc.infer(make_obs(50, i_probe), seq=i_probe,
+                     tenant=TENANT_PRIMARY)
+            i_probe += 1
+        except Exception:  # noqa: BLE001 — chaos; reconnect
+            try:
+                if pc is not None:
+                    pc.close()
+            except Exception:  # noqa: BLE001
+                pass
+            pc = None
+        if server1.ladder_level() == 0:
+            ladder_cleared = True
+            break
+        time.sleep(0.05)
+    if pc is not None:
+        try:
+            pc.close()
+        except Exception:  # noqa: BLE001
+            pass
+    recovered1 = run_until1(lambda: statuses1[-1] == "ok", min_s=0.5,
+                            max_s=20.0, collect=True)
+
+    tm1 = server1.telemetry_summary()
+    ledger = server1.ladder_ledger()
+    server1.close()
+
+    # per-arm oracle replay: every reply must carry the RIGHT arm's
+    # action and θ version for that exact observation
+    oracle_p = BatchedPolicy(net, seed=7, obs_dim=obs_dim, buckets=(8,))
+    wrong = missing = tenant_mm = version_mm = 0
+    for aid in got:
+        arm = TENANT_PRIMARY if aid >= 100 else arm_for(aid, arms)
+        oracle = oracle_p if arm == TENANT_PRIMARY else ab_src
+        want_ver = 7 if arm == TENANT_PRIMARY else 101
+        n_req = 40 if aid >= 100 else requests
+        for i in range(n_req):
+            rec = got[aid].get(i)
+            if rec is None:
+                missing += 1
+                continue
+            acts, ver, ten = rec
+            if ten != arm:
+                tenant_mm += 1
+            if ver != want_ver:
+                version_mm += 1
+            want, _ = oracle.forward(make_obs(aid, i))
+            if acts != tuple(int(a) for a in np.asarray(want)):
+                wrong += 1
+
+    # ---- arc 2: autoscaler executor closes the loop on processes ---------
+    replay2 = ReplayMemory(65536, (3,), np.float32, seed=0)
+    rserver = ReplayFeedServer(
+        replay2, flow=FlowConfig(ingest_factor=1.5, flush_credit_floor=8,
+                                 rate_halflife_s=0.5,
+                                 max_retry_after_s=0.05))
+    host2, port2 = rserver.address
+
+    consumer_stop = threading.Event()
+
+    def consumer() -> None:
+        # rate-capped learner stand-in: consumption rate is what the
+        # admission controller's ingest_factor is measured against
+        while not consumer_stop.is_set():
+            with rserver.replay_lock:
+                if len(replay2) >= 32:
+                    replay2.sample(32)
+                    sampled = True
+                else:
+                    sampled = False
+            if sampled:
+                rserver.note_consumed(32)
+            time.sleep(32 / 600.0)
+
+    consumer_t = threading.Thread(target=consumer, daemon=True)
+    consumer_t.start()
+
+    cfg2 = Config()
+    cfg2.actors.num_actors = 3
+    cfg2.actors.chaos = "drop=0.03,delay=0.05:30,seed=11"
+    sup = ActorSupervisor(cfg2, host2, port2, heartbeat_timeout=30.0,
+                          spawn_grace=60.0, target=_tenant_fleet_worker)
+    sup.start()
+
+    fleet2 = health.FleetHealth()
+    fleet2.register("replay", rserver.health_scrape)
+    autoscaler2 = Autoscaler(min_actors=2, max_actors=3, step=1,
+                             cooldown_s=0.3, recover_ticks=2)
+    executor = ScaleExecutor(
+        sup, rate_limit_s=0.25, drain_s=1.0, spawn_grace_s=30.0,
+        heartbeat_ok=lambda i: (rserver.last_seen.get(i, 0.0)
+                                > sup.spawned_at.get(i, float("inf"))),
+        stream_seq=rserver.stream_seq_of,
+        retire_stream=rserver.retire_stream)
+
+    statuses2: list[str] = []
+    rules2: set[str] = set()
+    decisions2: list[dict] = []
+    applied_all: list[dict] = []
+
+    def tick2(collect: bool = False) -> None:
+        v = fleet2.scrape()
+        statuses2.append(v.status)
+        if v.status == "critical":
+            critical_flaps[0] += 1
+        if collect and v.status != "ok":
+            rules2.update(f.rule for f in v.findings)
+        ds = autoscaler2.observe(v)
+        applied = executor.apply(ds)
+        ds_j = [d.to_jsonable() for d in ds]
+        decisions2.extend(ds_j)
+        rec = {**fleet2.gauges(), **autoscaler2.gauges(),
+               **executor.gauges(), "health/verdict": v.to_jsonable()}
+        if ds_j:
+            rec["autoscale/decision"] = ds_j
+        if applied:
+            rec["autoscale/applied"] = applied
+            applied_all.extend(applied)
+        metrics.log(step[0], **rec)
+        step[0] += 1
+        time.sleep(0.05)
+
+    def run_until2(pred, min_s: float = 0.0, max_s: float = 30.0,
+                   collect: bool = False) -> bool:
+        t1 = time.monotonic()
+        while True:
+            tick2(collect)
+            elapsed = time.monotonic() - t1
+            if elapsed >= min_s and pred():
+                return True
+            if elapsed > max_s:
+                return False
+
+    # phase 2a: the spawned fleet comes up and lands flushes
+    booted = run_until2(
+        lambda: statuses2[-1] == "ok"
+        and all(rserver.stream_seq_of(i) >= 0 for i in range(3)),
+        min_s=0.5, max_s=60.0)
+
+    # phase 2b: a burst producer outruns the consumer — ingest_shed
+    # burns, the autoscaler shrinks, and the executor retires a REAL
+    # process (drain, terminate, dedup-stamp eviction)
+    burst_stop = threading.Event()
+    burst_sheds = [0]
+
+    def burst() -> None:
+        # the raw stub, on purpose: the resilient client's credit token
+        # bucket paces a producer to its fair share, so a "burst" riding
+        # it reaches equilibrium and never trips admission. This loop
+        # ignores credits and hammers; it still resends the SAME
+        # flush_seq until the server acks (ok or duplicate), so the
+        # server-side dedup stamp keeps the ledger exactly-once
+        c: ReplayFeedClient | None = None
+        f = 0
+        sheds = 0
+        while not burst_stop.is_set():
+            ids = f * 1_000 + np.arange(256, dtype=np.float32)
+            obs = np.stack([ids, np.full(256, 9.0, np.float32),
+                            np.zeros(256, np.float32)], axis=1)
+            while not burst_stop.is_set():
+                try:
+                    if c is None:
+                        c = ReplayFeedClient(host2, port2, actor_id=9,
+                                             timeout=5.0)
+                    resp = c.call(
+                        "add_transitions", flush_seq=f, obs=obs,
+                        action=np.full(256, (9 * 31 + f) % 7, np.int32),
+                        reward=np.zeros(256, np.float32), next_obs=obs,
+                        discount=np.ones(256, np.float32))
+                except Exception:  # noqa: BLE001 — chaos; resend same f
+                    if c is not None:
+                        try:
+                            c.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                        c = None
+                    continue
+                if resp.get("shed"):
+                    sheds += 1
+                    trc.instant("shed", plane="replay")
+                    time.sleep(0.05)
+                    continue
+                if resp.get("error"):
+                    time.sleep(0.02)
+                    continue
+                break
+            f += 1
+        burst_sheds[0] = sheds
+        if c is not None:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    burst_t = threading.Thread(target=burst, daemon=True)
+    burst_t.start()
+    shrunk = run_until2(
+        lambda: any(a["action"] == "retire" and a["applied"]
+                    for a in applied_all),
+        max_s=40.0, collect=True)
+    burst_stop.set()
+    burst_t.join(timeout=60.0)
+    retired_ok = (sup.fleet_size() == 2
+                  and rserver.stream_seq_of(2) == -1
+                  and sup.executor_terminations == 1
+                  and sup.kill_escalations == 0)
+
+    # the eviction verb itself must be resend-safe on the wire: two
+    # literal retire_stream calls, each on a fresh chaos-wrapped
+    # connection, must both land as the same no-op
+    def mk2():
+        return ReplayFeedClient(host2, port2, actor_id=2, timeout=5.0)
+
+    r1 = _wire_retry(lambda c: c.call("retire_stream"), mk2)
+    r2 = _wire_retry(lambda c: c.call("retire_stream"), mk2)
+    evict_idempotent = (bool(r1.get("ok")) and bool(r2.get("ok"))
+                        and rserver.stream_seq_of(2) == -1)
+
+    # phase 2c: the pressure is gone — the recovery streak must grow
+    # the retired slot back and the fleet must land converged
+    regrew = run_until2(
+        lambda: any(a["action"] == "grow" and a["applied"]
+                    for a in applied_all),
+        max_s=60.0, collect=True)
+    settled = run_until2(
+        lambda: sup.fleet_size() == 3 and rserver.stream_seq_of(2) >= 0
+        and statuses2[-1] == "ok",
+        min_s=0.5, max_s=60.0)
+
+    sup.stop()
+    consumer_stop.set()
+    consumer_t.join(timeout=10.0)
+    shed_flushes = rserver.telemetry_summary().get("rpc/shed_flushes", 0.0)
+    rollbacks = executor.gauges()["autoscale/rollbacks"]
+    rserver.close()
+    metrics.close()
+    health.reset()
+    faultinject.uninstall()
+    wall = time.perf_counter() - t0
+
+    # labeled ledger over the replay ring: exactly-once per (id, salt)
+    # incarnation, every stored action matching its id's formula. No
+    # loss gate — the workers are open-ended and one was deliberately
+    # terminated mid-stream
+    n = len(replay2)
+    ids = replay2.obs[:n, 0].astype(np.int64)
+    gids = replay2.obs[:n, 1].astype(np.int64)
+    salts = replay2.obs[:n, 2].astype(np.int64)
+    pairs = list(zip(ids.tolist(), gids.tolist(), salts.tolist()))
+    duplicated = len(pairs) - len(set(pairs))
+    fs = ids // 1_000
+    wrong2 = int(np.sum(replay2.action[:n] != (gids * 31 + fs) % 7))
+
+    records = load_records(jsonl)
+    slo = slo_problems(records)
+    elastic = elastic_problems(records)
+    invalid = validate_records(records)
+    shrink_named = any(d["action"] == "shrink_actors"
+                       and d["rule"] == "ingest_shed" for d in decisions2)
+    grow_named = any(d["action"] == "grow_actors"
+                     and d["rule"] == RECOVERY_RULE for d in decisions2)
+    retire_applied = any(a["action"] == "retire" and a["applied"]
+                         and a["actor_id"] == 2 for a in applied_all)
+    grow_applied = any(a["action"] == "grow" and a["applied"]
+                       and a["actor_id"] == 2 for a in applied_all)
+    ledger_classes = [e["class"] for e in ledger]
+    total_sheds1 = sum(sheds1.values())
+    verdict = {
+        "ok": (not errors and hung1 == 0 and wrong == 0 and missing == 0
+               and tenant_mm == 0 and version_mm == 0
+               and warmed and lvl_up and prim_shed and shadow_rejected
+               and s1 > 0 and s2 == s1
+               and ledger_classes == ["shadow", "ab", "primary"]
+               and ladder_cleared and recovered1
+               and len(tenant_slo_hits) > 0
+               and tm1.get("inference/compiled_buckets", 0) <= 1
+               and booted and shrunk and retired_ok and evict_idempotent
+               and regrew and settled and shrink_named and grow_named
+               and retire_applied and grow_applied and rollbacks == 0
+               and duplicated == 0 and wrong2 == 0
+               and critical_flaps[0] == 0
+               and not slo and not elastic and not invalid),
+        # arc 1 — multi-tenant serving
+        "replies": sum(len(g) for g in got.values()),
+        "wrong_actions": wrong,
+        "missing_actions": missing,
+        "tenant_mismatches": tenant_mm,
+        "version_mismatches": version_mm,
+        "client_sheds": total_sheds1,
+        "ladder_ledger": ledger,
+        "ladder_cleared": ladder_cleared,
+        "shadow_requests": s1,
+        "shadow_frozen_under_shed": s2 == s1,
+        "shadow_direct_rejected": shadow_rejected,
+        "tenant_slo_findings": sorted(map(list, tenant_slo_hits)),
+        "compiled_buckets": tm1.get("inference/compiled_buckets", 0),
+        "tenants_served": tm1.get("tenant/served", 0),
+        "inference_recovered": recovered1,
+        # arc 2 — autoscaler executor
+        "booted": booted,
+        "shrunk": shrunk,
+        "regrew": regrew,
+        "settled": settled,
+        "shrink_on_ingest_shed": shrink_named,
+        "grow_on_recovery": grow_named,
+        "retire_applied": retire_applied,
+        "grow_applied": grow_applied,
+        "evict_idempotent": evict_idempotent,
+        "executor_terminations": sup.executor_terminations,
+        "kill_escalations": sup.kill_escalations,
+        "rollbacks": rollbacks,
+        "burst_sheds": burst_sheds[0],
+        "shed_flushes": shed_flushes,
+        "rules_fired": sorted(rules2),
+        "decisions": decisions2,
+        "applied": applied_all,
+        "transitions_stored": n,
+        "duplicated": duplicated,
+        "wrong_stored_actions": wrong2,
+        # shared gates
+        "critical_flaps": critical_flaps[0],
+        "slo_problems": slo,
+        "elastic_problems": elastic,
+        "invalid_records": invalid,
+        "faults_fired": dict(sorted(plan.counters.items())),
+        "hung_clients": hung1,
+        "errors": errors,
+        "wall_s": round(wall, 2),
+    }
+    trace = _trace_verdict(trc)
+    verdict["trace"] = trace
+    verdict["ok"] = (verdict["ok"] and trace["orphan_spans"] == 0
+                     and (total_sheds1 == 0
+                          or trace["instants"].get("shed", 0) > 0))
+    return verdict
+
+
 def _require_clean_gate() -> None:
     """Chaos results must never be reported for code with known race
     findings — refuse to run unless the static-analysis gate is clean."""
@@ -1682,6 +2325,10 @@ if __name__ == "__main__":
         if len(args) > 1 and args[1].isdigit():
             kwargs["num_actors"] = int(args[1])
         verdict = run_churn_smoke(**kwargs)
+        print(json.dumps(verdict))
+        sys.exit(0 if verdict["ok"] else 1)
+    if args and args[0] in ("tenants", "--tenants"):
+        verdict = run_tenants_smoke()
         print(json.dumps(verdict))
         sys.exit(0 if verdict["ok"] else 1)
     if args and args[0] in ("durability", "--durability"):

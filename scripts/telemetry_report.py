@@ -193,6 +193,39 @@ def elastic_problems(records: list[dict]) -> list[str]:
                          for k in ("burn_fast", "burn_slow")):
                 out.append(f"elastic: record {i}: decision "
                            f"'{d.get('rule')}' missing burn numbers")
+    # executor lineage (ISSUE 20): every APPLIED scale action must name
+    # the decision rule it executed — a process start/stop with no
+    # provenance is exactly the unauditable mutation the decision JSONL
+    # exists to prevent
+    for i, rec in enumerate(records):
+        applied = rec.get("autoscale/applied")
+        if applied is None:
+            continue
+        if isinstance(applied, dict):
+            applied = [applied]
+        if not isinstance(applied, list):
+            out.append(f"elastic: record {i}: autoscale/applied is "
+                       f"{type(applied).__name__}, not a list")
+            continue
+        for a in applied:
+            if not isinstance(a, dict) or not a.get("rule"):
+                out.append(f"elastic: record {i}: applied scale action "
+                           "without a named decision rule")
+            elif not a.get("action"):
+                out.append(f"elastic: record {i}: applied entry for rule "
+                           f"'{a.get('rule')}' names no action")
+    # applied vs target (ISSUE 20): with the executor on, the LAST
+    # record's fleet size must have converged to the scaler's target —
+    # a sustained mismatch means the control loop is open after all
+    applied_g = [v for v in _series(records, "autoscale/applied_actors")
+                 if isinstance(v, (int, float))]
+    target_g = [v for v in _series(records, "autoscale/target_actors")
+                if isinstance(v, (int, float))]
+    if applied_g and target_g and applied_g[-1] != target_g[-1]:
+        out.append(f"elastic: final autoscale/applied_actors "
+                   f"{int(applied_g[-1])} != autoscale/target_actors "
+                   f"{int(target_g[-1])} — executor did not converge "
+                   "on the scaler's target")
     return out
 
 

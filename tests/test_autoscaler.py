@@ -169,3 +169,133 @@ def test_elastic_problems_flags_missing_burn_numbers():
         [{"step": 0,
           "autoscale/decision": [_decision_dict(burn_fast=None)]}])
     assert len(probs) == 1 and "missing burn numbers" in probs[0]
+
+
+# -- ScaleExecutor: the acting half of the loop (ISSUE 20) ------------------
+
+from distributed_deep_q_tpu.actors.executor import ScaleExecutor  # noqa: E402
+
+
+class _FakeFleet:
+    """ActorSupervisor-shaped stub: an id set, no processes."""
+
+    def __init__(self, n: int):
+        self.ids = list(range(n))
+        self.reaped: list[int] = []
+
+    def fleet_size(self) -> int:
+        return len(self.ids)
+
+    def actor_ids(self) -> list[int]:
+        return sorted(self.ids)
+
+    def grow(self) -> int:
+        i = max(self.ids) + 1 if self.ids else 0
+        self.ids.append(i)
+        return i
+
+    def retire(self, i: int) -> bool:
+        if i not in self.ids:
+            return False
+        self.ids.remove(i)
+        return True
+
+    def reap_actor(self, i: int) -> bool:
+        self.reaped.append(i)
+        return self.retire(i)
+
+
+def _dec(action: str, from_n: int, to_n: int,
+         rule: str = "ingest_shed", t: float = 1.0) -> Decision:
+    return Decision(action=action, rule=rule, key="rpc/shed_flushes",
+                    member="replay", value=9.0, target=0.0,
+                    burn_fast=2.0, burn_slow=1.5,
+                    from_n=from_n, to_n=to_n, t=t)
+
+
+def test_executor_shrink_retires_highest_and_evicts_stamp():
+    sup = _FakeFleet(3)
+    evicted: list[int] = []
+    seqs: list[int] = []
+
+    def seq_of(i: int) -> int:
+        seqs.append(i)
+        return 7  # quiet stream: first re-poll matches, drain exits
+
+    ex = ScaleExecutor(sup, rate_limit_s=0.0, drain_s=0.3,
+                       stream_seq=seq_of, retire_stream=evicted.append)
+    out = ex.apply([_dec("shrink_actors", 3, 2)])
+    assert len(out) == 1
+    f = out[0]
+    assert f["action"] == "retire" and f["applied"] == 1
+    assert f["actor_id"] == 2 and f["rule"] == "ingest_shed"
+    assert f["decision_t"] == 1.0  # provenance back to the Decision
+    assert sup.actor_ids() == [0, 1]
+    assert evicted == [2]  # dedup stamp evicted AFTER the terminate
+    assert seqs.count(2) >= 2  # drained: seq polled until stable
+    g = ex.gauges()
+    assert g["autoscale/applied_actors"] == 2.0
+    assert g["autoscale/retirements"] == 1.0
+
+
+def test_executor_rate_limits_action_bursts():
+    sup = _FakeFleet(4)
+    ex = ScaleExecutor(sup, rate_limit_s=60.0, drain_s=0.0)
+    out = ex.apply([_dec("shrink_actors", 4, 3),
+                    _dec("shrink_actors", 3, 2)])
+    assert [f["action"] for f in out] == ["retire", "skip"]
+    assert out[1]["reason"] == "rate limited"
+    assert sup.fleet_size() == 3  # only the first action moved the fleet
+    assert ex.gauges()["autoscale/rate_limited"] == 1.0
+
+
+def test_executor_dry_run_touches_nothing():
+    sup = _FakeFleet(3)
+    ex = ScaleExecutor(sup, rate_limit_s=0.0, drain_s=0.0, dry_run=True)
+    out = ex.apply([_dec("shrink_actors", 3, 2),
+                    _dec("grow_actors", 3, 4, rule=RECOVERY_RULE)])
+    assert all(f["dry_run"] == 1 and f["applied"] == 0 for f in out)
+    assert sup.actor_ids() == [0, 1, 2]
+    assert ex.gauges()["autoscale/applied_actions"] == 0.0
+
+
+def test_executor_grow_rolls_back_silent_spawn():
+    sup = _FakeFleet(2)
+    now = [0.0]
+    ex = ScaleExecutor(sup, rate_limit_s=0.0, drain_s=0.0,
+                       spawn_grace_s=10.0, heartbeat_ok=lambda i: False,
+                       clock=lambda: now[0])
+    out = ex.apply([_dec("grow_actors", 2, 3, rule=RECOVERY_RULE)])
+    assert out[0]["action"] == "grow" and out[0]["applied"] == 1
+    assert sup.fleet_size() == 3
+    now[0] = 11.0  # grace window expires with no heartbeat
+    out = ex.apply([])
+    assert [f["action"] for f in out] == ["rollback"]
+    assert out[0]["rule"] == "spawn_grace" and out[0]["actor_id"] == 2
+    assert sup.reaped == [2] and sup.fleet_size() == 2
+    assert ex.gauges()["autoscale/rollbacks"] == 1.0
+
+
+def test_executor_grow_graduates_on_heartbeat():
+    sup = _FakeFleet(2)
+    now = [0.0]
+    ex = ScaleExecutor(sup, rate_limit_s=0.0, drain_s=0.0,
+                       spawn_grace_s=10.0, heartbeat_ok=lambda i: True,
+                       clock=lambda: now[0])
+    ex.apply([_dec("grow_actors", 2, 3, rule=RECOVERY_RULE)])
+    now[0] = 11.0
+    assert ex.apply([]) == []  # heartbeated: no rollback finding
+    assert sup.fleet_size() == 3
+    assert ex.gauges()["autoscale/rollbacks"] == 0.0
+
+
+def test_executor_skips_satisfied_and_foreign_decisions():
+    sup = _FakeFleet(3)
+    ex = ScaleExecutor(sup, rate_limit_s=0.0, drain_s=0.0)
+    out = ex.apply([_dec("grow_actors", 2, 3, rule=RECOVERY_RULE),
+                    _dec("grow_inference", 1, 2)])
+    assert [f["action"] for f in out] == ["skip", "skip"]
+    assert "at or above target" in out[0]["reason"]
+    assert "inference" in out[1]["reason"]
+    assert sup.fleet_size() == 3
+    assert ex.gauges()["autoscale/skipped"] == 2.0

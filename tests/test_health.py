@@ -151,6 +151,20 @@ def test_trend_drift_and_collapse():
     v = mon.verdict(t=4.0)
     assert v.status == "degraded" and v.findings[0].detail == "drift"
 
+    # a floor gates materiality: the same 3.3× jump stays quiet while
+    # the level is below it (windowed-p99 quantization noise), and
+    # fires once the series crosses it
+    floored = TrendRule("p99_drift", "rpc/*_ms_p99", kind="drift",
+                        ratio=3.0, min_points=4, floor=25.0)
+    mon = HealthMonitor(trends=(floored,))
+    for i, v in enumerate([0.2, 0.3, 0.2, 0.25, 1.0]):
+        mon.sample({"rpc/flush_ms_p99": float(v)}, t=float(i))
+    assert mon.verdict(t=4.0).ok
+    mon = HealthMonitor(trends=(floored,))
+    for i, v in enumerate([10, 11, 10, 12, 40]):
+        mon.sample({"rpc/flush_ms_p99": float(v)}, t=float(i))
+    assert mon.verdict(t=4.0).status == "degraded"
+
     collapse = TrendRule("ingest_dead", "flow/ingest_rate",
                          kind="collapse", ratio=0.2, floor=1.0)
     mon = HealthMonitor(trends=(collapse,))

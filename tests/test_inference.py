@@ -325,3 +325,157 @@ def test_distributed_remote_inference_end_to_end():
     assert np.isfinite(summary["loss"])
     assert summary["inference_requests"] > 0
     assert summary["inference_param_pulls"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant serving (ISSUE 20): per-tenant θ, A/B split, shadow mirror
+# ---------------------------------------------------------------------------
+
+from distributed_deep_q_tpu.rpc.inference_server import (  # noqa: E402
+    TENANT_PRIMARY, arm_for)
+
+
+def _rigged(weights, v: int, num_actions: int = 5):
+    """All-zero θ except the final Q bias, one-hot at ``v % A``: with
+    zero kernels every layer's contribution dies, so argmax action ==
+    v % A for ANY observation — a reply's actions spell out which θ
+    generation computed them, which is what lets the swap-race test
+    check (actions, version) consistency without reading server state."""
+    out = []
+    for w in weights:
+        z = np.zeros_like(np.asarray(w))
+        if z.ndim == 1 and z.shape[0] == num_actions:
+            z[v % num_actions] = 1.0
+        out.append(z)
+    return out
+
+
+def test_arm_split_deterministic_and_covers_arms():
+    arms = (TENANT_PRIMARY, "ab:cand")
+    picks = [arm_for(a, arms) for a in range(64)]
+    assert picks == [arm_for(a, arms) for a in range(64)]  # pure
+    assert set(picks) == set(arms)  # both arms actually get traffic
+    assert arm_for(3, ()) == TENANT_PRIMARY
+
+
+def test_tenants_serve_distinct_generations():
+    policy = BatchedPolicy(MLP, seed=11, obs_dim=6, buckets=(8,))
+    server = InferenceServer(policy, max_batch=8, cutoff_us=300,
+                             tenants=("ab:cand",))
+    host, port = server.address
+    base = policy.get_weights()
+    server.set_params(_rigged(base, 2), version=2)
+    server.set_params(_rigged(base, 3), version=3, tenant="ab:cand")
+    client = InferenceClient(host, port, actor_id=0)
+    try:
+        obs = np.random.default_rng(0).standard_normal(
+            (4, 6)).astype(np.float32)
+        rp = client.infer(obs, tenant=TENANT_PRIMARY)
+        ra = client.infer(obs, tenant="ab:cand")
+        assert rp["version"] == 2 and rp["tenant"] == TENANT_PRIMARY
+        assert ra["version"] == 3 and ra["tenant"] == "ab:cand"
+        assert all(int(a) == 2 for a in np.asarray(rp["actions"]))
+        assert all(int(a) == 3 for a in np.asarray(ra["actions"]))
+        tm = server.telemetry_summary()
+        assert tm["tenant/served"] >= 2.0
+        assert tm["tenant/ab:cand/requests"] == 1.0
+    finally:
+        client.close()
+        server.close()
+
+
+def test_shadow_is_mirror_only_and_counts_divergence():
+    policy = BatchedPolicy(MLP, seed=12, obs_dim=6, buckets=(8,))
+    server = InferenceServer(policy, max_batch=8, cutoff_us=300,
+                             tenants=("shadow:next",))
+    host, port = server.address
+    base = policy.get_weights()
+    server.set_params(_rigged(base, 1), version=1)
+    # shadow θ rigged to a DIFFERENT action: every mirrored row diverges
+    server.set_params(_rigged(base, 4), version=4, tenant="shadow:next")
+    client = InferenceClient(host, port, actor_id=5)
+    try:
+        rej = client.infer(np.zeros((2, 6), np.float32),
+                           tenant="shadow:next")
+        assert "mirror-only" in str(rej.get("error", ""))
+        for i in range(4):
+            r = client.infer(np.random.default_rng(i).standard_normal(
+                (4, 6)).astype(np.float32))
+            assert r["tenant"] == TENANT_PRIMARY  # never a shadow reply
+            assert all(int(a) == 1 for a in np.asarray(r["actions"]))
+        tm = server.telemetry_summary()
+        assert tm["tenant/shadow:next/shadow_requests"] >= 16.0
+        assert tm["tenant/shadow:next/shadow_diverged"] >= 16.0
+        assert tm["tenant/shadow:next/requests"] == 0.0  # served nobody
+    finally:
+        client.close()
+        server.close()
+
+
+def test_mid_batch_swap_keeps_reply_consistent():
+    """set_params racing _run_batch (ISSUE 20 satellite): every reply's
+    (actions, version) pair must come from ONE θ generation per tenant —
+    the rigged weights make any torn capture visible as an action that
+    contradicts the reply's own version stamp."""
+    policy = BatchedPolicy(MLP, seed=13, obs_dim=6, buckets=(8,))
+    server = InferenceServer(policy, max_batch=8, cutoff_us=2000,
+                             tenants=("ab:cand",))
+    host, port = server.address
+    base = policy.get_weights()
+    server.set_params(_rigged(base, 0), version=0)
+    server.set_params(_rigged(base, 1), version=1, tenant="ab:cand")
+    stop = threading.Event()
+    problems: list[str] = []
+
+    def swapper() -> None:
+        v = 2
+        while not stop.is_set():
+            server.set_params(_rigged(base, v), version=v)
+            server.set_params(_rigged(base, v + 1), version=v + 1,
+                              tenant="ab:cand")
+            v += 2
+            time.sleep(0.002)
+
+    def drive(aid: int, tenant: str) -> None:
+        rng = np.random.default_rng(aid)
+        c = InferenceClient(host, port, actor_id=aid)
+        try:
+            done = 0
+            while done < 40 and not problems:
+                obs = rng.standard_normal(
+                    (int(rng.integers(1, 6)), 6)).astype(np.float32)
+                r = c.infer(obs, seq=done, tenant=tenant)
+                if r.get("shed"):
+                    time.sleep(r.get("retry_after_ms", 10) / 1e3)
+                    continue
+                if "error" in r:
+                    problems.append(f"aid {aid}: {r['error']}")
+                    return
+                acts = np.asarray(r["actions"])
+                want = int(r["version"]) % 5
+                if r["tenant"] != tenant:
+                    problems.append(
+                        f"aid {aid}: tenant {r['tenant']} != {tenant}")
+                if not all(int(a) == want for a in acts):
+                    problems.append(
+                        f"aid {aid}: actions {acts.tolist()} vs version "
+                        f"{r['version']} (torn θ capture)")
+                done += 1
+        finally:
+            c.close()
+
+    sw = threading.Thread(target=swapper, daemon=True)
+    sw.start()
+    drivers = ([threading.Thread(target=drive, args=(a, TENANT_PRIMARY))
+                for a in (0, 1, 2)]
+               + [threading.Thread(target=drive, args=(a, "ab:cand"))
+                  for a in (3, 4)])
+    for t in drivers:
+        t.start()
+    for t in drivers:
+        t.join(timeout=60)
+    stop.set()
+    sw.join(timeout=10)
+    server.close()
+    assert problems == []
+    assert not any(t.is_alive() for t in drivers)
